@@ -1,0 +1,148 @@
+"""Unique IDs for jobs, tasks, objects, actors, nodes, placement groups.
+
+Counterpart of the reference's ID system (src/ray/common/id.h, id_def.h): binary
+IDs with embedded lineage — an ObjectID embeds the TaskID that produced it plus
+a return-index; a TaskID embeds the JobID. Redesigned compactly: 16 random bytes
+for base IDs; derived IDs are parent-bytes + suffix so ownership/lineage can be
+recovered from the ID alone (used by the object recovery path).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+_HEX = "0123456789abcdef"
+
+
+class BaseID:
+    SIZE = 16
+    __slots__ = ("_bytes",)
+
+    def __init__(self, binary: bytes):
+        if len(binary) != self.SIZE:
+            raise ValueError(
+                f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
+            )
+        self._bytes = binary
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls.SIZE))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\xff" * cls.SIZE)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\xff" * self.SIZE
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()[:12]}…)"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    SIZE = 4
+
+    @classmethod
+    def from_int(cls, i: int) -> "JobID":
+        return cls(struct.pack(">I", i))
+
+    def int(self) -> int:
+        return struct.unpack(">I", self._bytes)[0]
+
+
+class NodeID(BaseID):
+    SIZE = 16
+
+
+class WorkerID(BaseID):
+    SIZE = 16
+
+
+class ActorID(BaseID):
+    """12 random bytes + 4-byte job id suffix."""
+
+    SIZE = 16
+
+    @classmethod
+    def of(cls, job_id: JobID) -> "ActorID":
+        return cls(os.urandom(12) + job_id.binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[12:])
+
+
+class TaskID(BaseID):
+    """12 identifying bytes + 4-byte job id suffix, so job_id() is always
+    recoverable (normal tasks: random; actor tasks: actor prefix + seq_no)."""
+
+    SIZE = 16
+
+    @classmethod
+    def for_task(cls, job_id: JobID) -> "TaskID":
+        return cls(os.urandom(12) + job_id.binary())
+
+    @classmethod
+    def for_actor_task(cls, actor_id: ActorID, seq_no: int) -> "TaskID":
+        return cls(
+            actor_id.binary()[:8]
+            + struct.pack(">I", seq_no & 0xFFFFFFFF)
+            + actor_id.job_id().binary()
+        )
+
+    @classmethod
+    def for_actor_creation(cls, actor_id: ActorID) -> "TaskID":
+        # actor_id bytes 12..16 already are the job id.
+        return cls(b"\x00\x00\x00\x00" + actor_id.binary()[:8] + actor_id.job_id().binary())
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[12:])
+
+
+class ObjectID(BaseID):
+    """TaskID (16) + 4-byte return index: lineage is recoverable from the ID
+    (reference: ObjectID::ForTaskReturn, id.h)."""
+
+    SIZE = 20
+
+    @classmethod
+    def for_task_return(cls, task_id: TaskID, index: int) -> "ObjectID":
+        return cls(task_id.binary() + struct.pack(">I", index))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int) -> "ObjectID":
+        # Puts use the high bit of the index to distinguish from returns.
+        return cls(task_id.binary() + struct.pack(">I", 0x80000000 | put_index))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:16])
+
+    def return_index(self) -> int:
+        return struct.unpack(">I", self._bytes[16:])[0] & 0x7FFFFFFF
+
+    def is_put(self) -> bool:
+        return bool(struct.unpack(">I", self._bytes[16:])[0] & 0x80000000)
+
+
+class PlacementGroupID(BaseID):
+    SIZE = 16

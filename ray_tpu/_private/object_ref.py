@@ -1,0 +1,81 @@
+"""ObjectRef — a distributed future (reference: python/ray/includes/object_ref.pxi:36).
+
+Carries the owner's RPC address so any borrower can resolve the value and
+report reference counts back to the owner (the ownership model of
+src/ray/core_worker/reference_count.h, re-expressed in Python).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+# (host, port) of the owning worker's RPC server; None = owned locally.
+Address = Optional[Tuple[str, int]]
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_address", "_borrowed", "_registered")
+
+    def __init__(self, id: ObjectID, owner_address: Address = None, _borrowed: bool = False):
+        self.id = id
+        self.owner_address = owner_address
+        self._borrowed = _borrowed
+        self._registered = False
+        if _borrowed:
+            self._register_borrow()
+
+    def _register_borrow(self) -> None:
+        try:
+            from ray_tpu._private import worker as worker_mod
+        except ImportError:
+            return
+        w = worker_mod.global_worker_or_none()
+        if w is not None:
+            w.reference_counter.add_borrowed_ref(self)
+            self._registered = True
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod.global_worker().get_async(self)
+
+    def __await__(self):
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod.global_worker().await_ref(self).__await__()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()})"
+
+    def __del__(self):
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker_or_none()
+            if w is not None:
+                w.reference_counter.remove_local_ref(self.id)
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        # Direct pickling (outside the runtime's serializer) keeps owner info.
+        return (_rebuild_ref, (self.id.binary(), self.owner_address))
+
+
+def _rebuild_ref(binary: bytes, owner_address: Address) -> "ObjectRef":
+    return ObjectRef(ObjectID(binary), owner_address=owner_address, _borrowed=True)

@@ -1,0 +1,286 @@
+"""Object plane: per-node shared-memory store + per-worker memory store.
+
+Counterparts in the reference:
+- ``SharedMemoryStore`` ≙ plasma client (src/ray/object_manager/plasma/client.h:241)
+  over the native arena in ray_tpu/native/shm_store.cc.
+- ``MemoryStore`` ≙ the core worker's in-memory store for small/inlined objects
+  (src/ray/core_worker/store_provider/memory_store/memory_store.h:45) — holds
+  SerializedObjects and wakes blocked getters via asyncio events.
+
+Serialized values are stored as: [u32 metadata_len][metadata][u32 nbufs]
+([u64 buf_len][buf])* so multi-buffer zero-copy objects round-trip without an
+extra concatenation copy on write.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import struct
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import SerializedObject
+from ray_tpu.exceptions import ObjectStoreFullError
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+SHM_OK = 0
+SHM_ERR_EXISTS = -1
+SHM_ERR_NOT_FOUND = -2
+SHM_ERR_FULL = -3
+
+
+def _load_native():
+    from ray_tpu.native import build_library
+
+    lib = ctypes.CDLL(build_library("shm_store"))
+    lib.shm_store_create.restype = ctypes.c_void_p
+    lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shm_store_open.restype = ctypes.c_void_p
+    lib.shm_store_open.argtypes = [ctypes.c_char_p]
+    lib.shm_store_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.shm_store_abort.restype = ctypes.c_int
+    lib.shm_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_reclaim_stale.restype = ctypes.c_int
+    lib.shm_store_reclaim_stale.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.shm_store_create_object.restype = ctypes.c_int
+    lib.shm_store_create_object.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.shm_store_seal.restype = ctypes.c_int
+    lib.shm_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_get.restype = ctypes.c_int
+    lib.shm_store_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.shm_store_contains.restype = ctypes.c_int
+    lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_release.restype = ctypes.c_int
+    lib.shm_store_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_delete.restype = ctypes.c_int
+    lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_base.restype = ctypes.c_void_p
+    lib.shm_store_base.argtypes = [ctypes.c_void_p]
+    lib.shm_store_map_size.restype = ctypes.c_uint64
+    lib.shm_store_map_size.argtypes = [ctypes.c_void_p]
+    lib.shm_store_bytes_in_use.restype = ctypes.c_uint64
+    lib.shm_store_bytes_in_use.argtypes = [ctypes.c_void_p]
+    lib.shm_store_capacity.restype = ctypes.c_uint64
+    lib.shm_store_capacity.argtypes = [ctypes.c_void_p]
+    lib.shm_store_num_objects.restype = ctypes.c_uint64
+    lib.shm_store_num_objects.argtypes = [ctypes.c_void_p]
+    lib.shm_store_prefault.restype = None
+    lib.shm_store_prefault.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    return lib
+
+
+_native_lib = None
+_native_lock = threading.Lock()
+
+
+def native_lib():
+    global _native_lib
+    with _native_lock:
+        if _native_lib is None:
+            _native_lib = _load_native()
+    return _native_lib
+
+
+class SharedMemoryStore:
+    """ctypes client of the native arena. Thread-safe (the native side locks)."""
+
+    def __init__(self, path: str, capacity: Optional[int] = None, create: bool = False):
+        self.path = path
+        self._lib = native_lib()
+        if create:
+            assert capacity is not None
+            self._handle = self._lib.shm_store_create(path.encode(), capacity)
+        else:
+            self._handle = self._lib.shm_store_open(path.encode())
+        if not self._handle:
+            raise OSError(f"failed to {'create' if create else 'open'} shm store {path}")
+        # Background page prefault: first-touch tmpfs page allocation would
+        # otherwise dominate large puts (see shm_store.cc:shm_store_prefault).
+        self._lib.shm_store_prefault(self._handle, 1 if create else 0)
+        base = self._lib.shm_store_base(self._handle)
+        size = self._lib.shm_store_map_size(self._handle)
+        self._base_addr = base
+        self._view = (ctypes.c_char * size).from_address(base)
+        self._mem = memoryview(self._view).cast("B")
+
+    # -- raw bytes API --
+
+    def put_raw(self, object_id: ObjectID, payload_parts: List[bytes]) -> bool:
+        """Write an object as concatenated parts. False if it already exists."""
+        total = sum(len(p) for p in payload_parts)
+        off = ctypes.c_uint64()
+        rc = self._lib.shm_store_create_object(
+            self._handle, object_id.binary(), total, ctypes.byref(off)
+        )
+        if rc == SHM_ERR_EXISTS:
+            return False
+        if rc == SHM_ERR_FULL:
+            raise ObjectStoreFullError(
+                f"object of {total} bytes does not fit in store {self.path}"
+            )
+        if rc != SHM_OK:
+            raise OSError(f"shm create failed rc={rc}")
+        try:
+            pos = off.value
+            for part in payload_parts:
+                n = len(part)
+                src = bytes(part) if isinstance(part, memoryview) else part
+                ctypes.memmove(self._base_addr + pos, src, n)
+                pos += n
+        except BaseException:
+            self._lib.shm_store_abort(self._handle, object_id.binary())
+            raise
+        self._lib.shm_store_seal(self._handle, object_id.binary())
+        self._lib.shm_store_release(self._handle, object_id.binary())
+        return True
+
+    def get_raw(self, object_id: ObjectID) -> Optional[memoryview]:
+        """Zero-copy view of a sealed object, or None. Caller must release()."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.shm_store_get(
+            self._handle, object_id.binary(), ctypes.byref(off), ctypes.byref(size)
+        )
+        if rc != SHM_OK:
+            return None
+        return self._mem[off.value : off.value + size.value]
+
+    def release(self, object_id: ObjectID) -> None:
+        self._lib.shm_store_release(self._handle, object_id.binary())
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return bool(self._lib.shm_store_contains(self._handle, object_id.binary()))
+
+    def delete(self, object_id: ObjectID) -> None:
+        self._lib.shm_store_delete(self._handle, object_id.binary())
+
+    # -- SerializedObject API --
+
+    def put_serialized(self, object_id: ObjectID, obj: SerializedObject) -> bool:
+        parts = [struct.pack(">I", len(obj.metadata)), obj.metadata,
+                 struct.pack(">I", len(obj.buffers))]
+        for buf in obj.buffers:
+            parts.append(struct.pack(">Q", len(buf)))
+            parts.append(buf)
+        return self.put_raw(object_id, parts)
+
+    def get_serialized(self, object_id: ObjectID) -> Optional[SerializedObject]:
+        """Reconstruct a SerializedObject. Buffers are zero-copy memoryviews
+        into the arena; the object stays pinned until release()."""
+        view = self.get_raw(object_id)
+        if view is None:
+            return None
+        (mlen,) = struct.unpack(">I", view[:4])
+        metadata = bytes(view[4 : 4 + mlen])
+        pos = 4 + mlen
+        (nbufs,) = struct.unpack(">I", view[pos : pos + 4])
+        pos += 4
+        buffers: List[memoryview] = []
+        for _ in range(nbufs):
+            (blen,) = struct.unpack(">Q", view[pos : pos + 8])
+            pos += 8
+            buffers.append(view[pos : pos + blen])
+            pos += blen
+        return SerializedObject(metadata, buffers, [])  # type: ignore[arg-type]
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "capacity": self._lib.shm_store_capacity(self._handle),
+            "bytes_in_use": self._lib.shm_store_bytes_in_use(self._handle),
+            "num_objects": self._lib.shm_store_num_objects(self._handle),
+        }
+
+    def reclaim_stale(self, age_s: int = 60) -> int:
+        """Reclaim orphaned in-progress creates from dead writers."""
+        return self._lib.shm_store_reclaim_stale(self._handle, age_s)
+
+    def close(self, unmap: bool = False) -> None:
+        """Close the handle. By default the mapping stays alive until process
+        exit because zero-copy views from get_raw may still be referenced;
+        pass unmap=True only when no views can be outstanding."""
+        if self._handle:
+            if unmap:
+                self._mem = None  # type: ignore[assignment]
+                self._view = None  # type: ignore[assignment]
+            self._lib.shm_store_close(self._handle, 1 if unmap else 0)
+            self._handle = None
+
+
+class MemoryStore:
+    """Per-worker in-memory store for small objects and pending task returns.
+
+    Async-first: getters await an asyncio.Event per object, mirroring the
+    reference memory store's GetAsync callback chain.
+    """
+
+    class _Waiter:
+        __slots__ = ("event", "count")
+
+        def __init__(self):
+            self.event = asyncio.Event()
+            self.count = 0
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._objects: Dict[ObjectID, SerializedObject] = {}
+        self._events: Dict[ObjectID, "MemoryStore._Waiter"] = {}
+        self._lock = threading.Lock()
+
+    def put(self, object_id: ObjectID, obj: SerializedObject) -> None:
+        with self._lock:
+            self._objects[object_id] = obj
+            waiter = self._events.pop(object_id, None)
+        if waiter is not None:
+            self._loop.call_soon_threadsafe(waiter.event.set)
+
+    def get_if_exists(self, object_id: ObjectID) -> Optional[SerializedObject]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._objects
+
+    async def get(self, object_id: ObjectID,
+                  timeout: Optional[float] = None) -> SerializedObject:
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                return obj
+            waiter = self._events.get(object_id)
+            if waiter is None:
+                waiter = MemoryStore._Waiter()
+                self._events[object_id] = waiter
+            waiter.count += 1
+        try:
+            await asyncio.wait_for(waiter.event.wait(), timeout)
+        finally:
+            with self._lock:
+                waiter.count -= 1
+                if waiter.count == 0 and self._events.get(object_id) is waiter:
+                    del self._events[object_id]
+        with self._lock:
+            obj = self._objects.get(object_id)
+        if obj is None:
+            from ray_tpu.exceptions import ObjectLostError
+
+            raise ObjectLostError(f"object {object_id} deleted while waiting")
+        return obj
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._objects.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)

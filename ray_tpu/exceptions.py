@@ -1,0 +1,70 @@
+"""Public exception types (reference: python/ray/exceptions.py)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTaskError(RayTpuError):
+    """A task raised an exception; re-raised at ray_tpu.get with the remote
+    traceback attached (reference: exceptions.py RayTaskError)."""
+
+    def __init__(self, message: str, cause: Optional[BaseException] = None,
+                 traceback_str: str = ""):
+        super().__init__(message)
+        self.cause = cause
+        self.traceback_str = traceback_str
+
+    def __str__(self):
+        base = super().__str__()
+        if self.traceback_str:
+            return f"{base}\n\nRemote traceback:\n{self.traceback_str}"
+        return base
+
+
+class RayActorError(RayTpuError):
+    """The actor died before or during method execution."""
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    """Actor temporarily unreachable (e.g. restarting)."""
+
+
+class TaskCancelledError(RayTpuError):
+    pass
+
+
+class ObjectLostError(RayTpuError):
+    """Object's value was lost and could not be reconstructed from lineage."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    pass
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    pass
+
+
+class WorkerCrashedError(RayTpuError):
+    pass
+
+
+class NodeDiedError(RayTpuError):
+    pass
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    pass
+
+
+class PlacementGroupSchedulingError(RayTpuError):
+    pass

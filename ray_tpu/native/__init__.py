@@ -1,0 +1,47 @@
+"""Native (C++) components, built on demand with g++.
+
+The compiled artifacts are cached next to the sources; a content hash of the
+source file invalidates the cache on change.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_build_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_library(source_name: str, extra_flags: tuple = ()) -> str:
+    """Compile ``<source_name>.cc`` into ``lib<source_name>.so`` and return
+    its path. No-op if the cached build is current."""
+    src = os.path.join(_NATIVE_DIR, f"{source_name}.cc")
+    lib = os.path.join(_NATIVE_DIR, f"lib{source_name}.so")
+    stamp = os.path.join(_NATIVE_DIR, f".{source_name}.hash")
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read() + repr(extra_flags).encode()).hexdigest()
+    with _build_lock:
+        if os.path.exists(lib) and os.path.exists(stamp):
+            with open(stamp) as f:
+                if f.read().strip() == digest:
+                    return lib
+        cmd = [
+            "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+            "-o", lib + ".tmp", src, "-lpthread", *extra_flags,
+        ]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"g++ failed for {source_name}:\n{proc.stderr}"
+            )
+        os.replace(lib + ".tmp", lib)
+        with open(stamp, "w") as f:
+            f.write(digest)
+    return lib

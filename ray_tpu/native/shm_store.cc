@@ -1,0 +1,518 @@
+// ray_tpu shared-memory object store (native component).
+//
+// TPU-native counterpart of the reference's plasma store
+// (/root/reference/src/ray/object_manager/plasma/{store.h,client.h,dlmalloc.cc},
+// eviction_policy.h) — redesigned, not ported. Plasma runs a store *server*
+// inside the raylet: clients talk over a unix socket, receive mmap fds, and
+// every Create/Seal/Get/Release is a protocol round-trip. Here the arena is a
+// single file in /dev/shm that every process on the host maps directly; the
+// object table and the allocator free-list live *inside* the shared mapping,
+// guarded by one process-shared robust pthread mutex. Gets of sealed objects
+// take the lock only to pin; reads are zero-copy pointers into the mapping.
+//
+// Capabilities kept from plasma: Create/Seal/Get/Release/Delete/Contains,
+// pinning (refcounts), LRU eviction of unpinned sealed objects on pressure
+// (eviction_policy.h:104), create backpressure via ENOSPC errors
+// (create_request_queue.h — the Python layer retries/spills).
+//
+// Build: g++ -O2 -shared -fPIC -o libshm_store.so shm_store.cc -lpthread
+// Exposed to Python via ctypes (ray_tpu/core/object_store.py).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <thread>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52415954505553ULL;  // "RAYTPUS"
+constexpr int kIdSize = 20;
+constexpr uint64_t kAlign = 64;
+
+// ---- object table entry states ----
+enum EntryState : uint32_t {
+  kEmpty = 0,
+  kCreated = 1,  // allocated, being written
+  kSealed = 2,   // immutable, readable
+  kTombstone = 3,
+};
+
+struct Entry {
+  uint8_t id[kIdSize];
+  uint32_t state;
+  uint64_t offset;  // data offset from arena base
+  uint64_t size;
+  int32_t pins;     // get() pins; evictable only at 0
+  uint32_t pad;
+  uint64_t lru_tick;
+  uint64_t create_ts;  // wall-clock seconds; for stale-create reclamation
+};
+
+// ---- free-list block header (lives in the data region) ----
+struct Block {
+  uint64_t size;      // payload bytes (excluding header)
+  uint64_t next_off;  // next free block offset (0 = none), valid when free
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;       // total data region bytes
+  uint64_t table_offset;   // from mapping base
+  uint64_t table_slots;
+  uint64_t data_offset;    // from mapping base
+  uint64_t free_head;      // offset of first free block (from data base), 0=none
+  uint64_t lru_clock;
+  uint64_t bytes_in_use;
+  uint64_t num_objects;
+  pthread_mutex_t mutex;
+};
+
+struct Store {
+  Header* hdr;
+  uint8_t* base;  // mapping base
+  uint64_t map_size;
+  int fd;
+  std::atomic<bool> stop_prefault{false};
+  std::thread prefault_thread;
+};
+
+inline Entry* table(Store* s) {
+  return reinterpret_cast<Entry*>(s->base + s->hdr->table_offset);
+}
+inline uint8_t* data_base(Store* s) { return s->base + s->hdr->data_offset; }
+inline Block* block_at(Store* s, uint64_t off) {
+  return reinterpret_cast<Block*>(data_base(s) + off);
+}
+
+uint64_t hash_id(const uint8_t* id) {
+  // FNV-1a over the 20-byte id.
+  uint64_t h = 1469598103934665603ULL;
+  for (int i = 0; i < kIdSize; i++) {
+    h ^= id[i];
+    h *= 1099511628211ULL;
+  }
+  return h;
+}
+
+class Locker {
+ public:
+  explicit Locker(Store* s) : s_(s) {
+    int rc = pthread_mutex_lock(&s_->hdr->mutex);
+    if (rc == EOWNERDEAD) {
+      // A client died holding the lock; state is still structurally valid
+      // because mutations are ordered (allocate fully, then publish entry).
+      pthread_mutex_consistent(&s_->hdr->mutex);
+    }
+  }
+  ~Locker() { pthread_mutex_unlock(&s_->hdr->mutex); }
+
+ private:
+  Store* s_;
+};
+
+// Find entry slot for id; returns sealed/created entry or nullptr.
+Entry* find(Store* s, const uint8_t* id) {
+  Entry* t = table(s);
+  uint64_t slots = s->hdr->table_slots;
+  uint64_t i = hash_id(id) % slots;
+  for (uint64_t probe = 0; probe < slots; probe++) {
+    Entry* e = &t[(i + probe) % slots];
+    if (e->state == kEmpty) return nullptr;
+    if (e->state != kTombstone && memcmp(e->id, id, kIdSize) == 0) return e;
+  }
+  return nullptr;
+}
+
+Entry* find_slot_for_insert(Store* s, const uint8_t* id) {
+  Entry* t = table(s);
+  uint64_t slots = s->hdr->table_slots;
+  uint64_t i = hash_id(id) % slots;
+  Entry* first_tomb = nullptr;
+  for (uint64_t probe = 0; probe < slots; probe++) {
+    Entry* e = &t[(i + probe) % slots];
+    if (e->state == kEmpty) return first_tomb ? first_tomb : e;
+    if (e->state == kTombstone) {
+      if (!first_tomb) first_tomb = e;
+    } else if (memcmp(e->id, id, kIdSize) == 0) {
+      return nullptr;  // already exists
+    }
+  }
+  return first_tomb;
+}
+
+uint64_t align_up(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+// First-fit allocate from the shared free list. Returns data offset of the
+// payload or UINT64_MAX on failure. Caller holds the lock.
+uint64_t alloc(Store* s, uint64_t want) {
+  want = align_up(want);
+  uint64_t prev = 0;
+  uint64_t cur = s->hdr->free_head;
+  while (cur != 0) {
+    Block* b = block_at(s, cur);
+    if (b->size >= want) {
+      uint64_t remaining = b->size - want;
+      if (remaining > sizeof(Block) + kAlign) {
+        // Split: carve the tail into a new free block.
+        uint64_t new_off = cur + sizeof(Block) + want;
+        Block* nb = block_at(s, new_off);
+        nb->size = remaining - sizeof(Block);
+        nb->next_off = b->next_off;
+        b->size = want;
+        if (prev) block_at(s, prev)->next_off = new_off;
+        else s->hdr->free_head = new_off;
+      } else {
+        if (prev) block_at(s, prev)->next_off = b->next_off;
+        else s->hdr->free_head = b->next_off;
+      }
+      s->hdr->bytes_in_use += b->size + sizeof(Block);
+      return cur + sizeof(Block);
+    }
+    prev = cur;
+    cur = b->next_off;
+  }
+  return UINT64_MAX;
+}
+
+// Free payload at data offset; insert into address-ordered free list and
+// coalesce with neighbors. Caller holds the lock.
+void dealloc(Store* s, uint64_t payload_off) {
+  uint64_t off = payload_off - sizeof(Block);
+  Block* b = block_at(s, off);
+  s->hdr->bytes_in_use -= b->size + sizeof(Block);
+  // Address-ordered insert.
+  uint64_t prev = 0, cur = s->hdr->free_head;
+  while (cur != 0 && cur < off) {
+    prev = cur;
+    cur = block_at(s, cur)->next_off;
+  }
+  b->next_off = cur;
+  if (prev) block_at(s, prev)->next_off = off;
+  else s->hdr->free_head = off;
+  // Coalesce with next.
+  if (cur != 0 && off + sizeof(Block) + b->size == cur) {
+    Block* nb = block_at(s, cur);
+    b->size += sizeof(Block) + nb->size;
+    b->next_off = nb->next_off;
+  }
+  // Coalesce with prev.
+  if (prev != 0) {
+    Block* pb = block_at(s, prev);
+    if (prev + sizeof(Block) + pb->size == off) {
+      pb->size += sizeof(Block) + b->size;
+      pb->next_off = b->next_off;
+    }
+  }
+}
+
+// Evict the single globally-LRU unpinned sealed object. Returns false when
+// nothing is evictable. O(n) table scan — fine at single-host object counts
+// (reference plasma also walks its LRU cache, eviction_policy.h:159).
+bool evict_one(Store* s) {
+  Entry* t = table(s);
+  uint64_t slots = s->hdr->table_slots;
+  Entry* victim = nullptr;
+  for (uint64_t i = 0; i < slots; i++) {
+    Entry* e = &t[i];
+    if (e->state == kSealed && e->pins == 0) {
+      if (!victim || e->lru_tick < victim->lru_tick) victim = e;
+    }
+  }
+  if (!victim) return false;
+  dealloc(s, victim->offset);
+  victim->state = kTombstone;
+  s->hdr->num_objects--;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Error codes
+enum {
+  SHM_OK = 0,
+  SHM_ERR_EXISTS = -1,
+  SHM_ERR_NOT_FOUND = -2,
+  SHM_ERR_FULL = -3,
+  SHM_ERR_STATE = -4,
+  SHM_ERR_SYS = -5,
+  SHM_ERR_TABLE_FULL = -6,
+};
+
+// Create a new store arena backed by `path` (a /dev/shm file) with `capacity`
+// data bytes. Returns handle or null.
+void* shm_store_create(const char* path, uint64_t capacity) {
+  uint64_t slots = capacity / 65536;
+  if (slots < 4096) slots = 4096;
+  uint64_t table_bytes = slots * sizeof(Entry);
+  uint64_t map_size = align_up(sizeof(Header)) + align_up(table_bytes) + capacity;
+
+  int fd = open(path, O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, (off_t)map_size) != 0) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    unlink(path);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(mem);
+  s->map_size = map_size;
+  s->fd = fd;
+  s->hdr = reinterpret_cast<Header*>(s->base);
+  Header* h = s->hdr;
+  memset(h, 0, sizeof(Header));
+  h->capacity = capacity;
+  h->table_offset = align_up(sizeof(Header));
+  h->table_slots = slots;
+  h->data_offset = h->table_offset + align_up(table_bytes);
+  memset(s->base + h->table_offset, 0, table_bytes);
+  // One giant free block. It starts at kAlign, not 0, because offset 0 is the
+  // free-list "none" sentinel.
+  Block* b = block_at(s, kAlign);
+  b->size = capacity - kAlign - sizeof(Block);
+  b->next_off = 0;
+  h->free_head = kAlign;
+  h->bytes_in_use = 0;
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  __sync_synchronize();
+  h->magic = kMagic;
+  return s;
+}
+
+// Open an existing arena.
+void* shm_store_open(const char* path) {
+  int fd = open(path, O_RDWR);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store();
+  s->base = static_cast<uint8_t*>(mem);
+  s->map_size = (uint64_t)st.st_size;
+  s->fd = fd;
+  s->hdr = reinterpret_cast<Header*>(s->base);
+  if (s->hdr->magic != kMagic) {
+    munmap(mem, s->map_size);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+// do_unmap=0 leaves the mapping alive until process exit — safe when
+// zero-copy views handed out by get() may still be referenced somewhere
+// (unmapping under a live view is a SIGSEGV, not a Python error).
+void shm_store_close(void* handle, int do_unmap) {
+  Store* s = static_cast<Store*>(handle);
+  s->stop_prefault.store(true);
+  if (s->prefault_thread.joinable()) s->prefault_thread.join();
+  if (do_unmap) munmap(s->base, s->map_size);
+  close(s->fd);
+  delete s;
+}
+
+// Allocate an object of `size`; returns SHM_OK and writes the payload offset
+// (relative to the mapping base, for direct writes via the Python mmap view).
+int shm_store_create_object(void* handle, const uint8_t* id, uint64_t size,
+                            uint64_t* out_offset) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  if (find(s, id)) return SHM_ERR_EXISTS;
+  uint64_t off = alloc(s, size);
+  while (off == UINT64_MAX) {
+    if (!evict_one(s)) return SHM_ERR_FULL;
+    off = alloc(s, size);
+  }
+  Entry* e = find_slot_for_insert(s, id);
+  if (!e) {
+    dealloc(s, off);
+    return SHM_ERR_TABLE_FULL;
+  }
+  memcpy(e->id, id, kIdSize);
+  e->offset = off;
+  e->size = size;
+  e->pins = 1;  // creator holds a pin until seal+release
+  e->lru_tick = ++s->hdr->lru_clock;
+  e->create_ts = (uint64_t)time(nullptr);
+  __sync_synchronize();
+  e->state = kCreated;
+  s->hdr->num_objects++;
+  *out_offset = s->hdr->data_offset + off;
+  return SHM_OK;
+}
+
+// Abort an in-progress create (e.g. the writer hit an exception mid-copy):
+// frees the allocation immediately.
+int shm_store_abort(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find(s, id);
+  if (!e) return SHM_ERR_NOT_FOUND;
+  if (e->state != kCreated) return SHM_ERR_STATE;
+  dealloc(s, e->offset);
+  e->state = kTombstone;
+  s->hdr->num_objects--;
+  return SHM_OK;
+}
+
+// Reclaim kCreated entries older than age_s whose creator presumably died
+// between create and seal (the reference's plasma reclaims these via client
+// disconnect tracking; we use age since there is no store server watching
+// sockets). Called periodically by the node manager. Returns count reclaimed.
+int shm_store_reclaim_stale(void* handle, uint64_t age_s) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  uint64_t now = (uint64_t)time(nullptr);
+  Entry* t = table(s);
+  int reclaimed = 0;
+  for (uint64_t i = 0; i < s->hdr->table_slots; i++) {
+    Entry* e = &t[i];
+    if (e->state == kCreated && now - e->create_ts > age_s) {
+      dealloc(s, e->offset);
+      e->state = kTombstone;
+      s->hdr->num_objects--;
+      reclaimed++;
+    }
+  }
+  return reclaimed;
+}
+
+int shm_store_seal(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find(s, id);
+  if (!e) return SHM_ERR_NOT_FOUND;
+  if (e->state != kCreated) return SHM_ERR_STATE;
+  __sync_synchronize();
+  e->state = kSealed;
+  return SHM_OK;
+}
+
+// Look up a sealed object and pin it. Writes mapping-relative offset + size.
+int shm_store_get(void* handle, const uint8_t* id, uint64_t* out_offset,
+                  uint64_t* out_size) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find(s, id);
+  if (!e || e->state != kSealed) return SHM_ERR_NOT_FOUND;
+  e->pins++;
+  e->lru_tick = ++s->hdr->lru_clock;
+  *out_offset = s->hdr->data_offset + e->offset;
+  *out_size = e->size;
+  return SHM_OK;
+}
+
+int shm_store_contains(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find(s, id);
+  return (e && e->state == kSealed) ? 1 : 0;
+}
+
+// Unpin (one balanced call per successful get / create).
+int shm_store_release(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find(s, id);
+  if (!e) return SHM_ERR_NOT_FOUND;
+  if (e->pins > 0) e->pins--;
+  return SHM_OK;
+}
+
+// Delete: frees now if unpinned, else marks for deletion on last release.
+int shm_store_delete(void* handle, const uint8_t* id) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* e = find(s, id);
+  if (!e) return SHM_ERR_NOT_FOUND;
+  if (e->pins > 0) {
+    // Make it evictable/invisible: demote to sealed-unpinned semantics by
+    // leaving it; actual deletion happens on eviction. Simpler: refuse.
+    return SHM_ERR_STATE;
+  }
+  dealloc(s, e->offset);
+  e->state = kTombstone;
+  s->hdr->num_objects--;
+  return SHM_OK;
+}
+
+// Fault the arena's pages in from a background thread. tmpfs first-touch page
+// allocation is the dominant cost of large writes on some hosts (the reference
+// has the same knob: RAY_preallocate_plasma_memory / MAP_POPULATE). Two modes:
+// - writer=1 (arena creator): per-page atomic CAS that writes back the value
+//   it read — allocates the page but can never clobber a concurrent client
+//   write (the CAS only stores if the word is unchanged, and then stores the
+//   same bytes).
+// - writer=0 (clients): plain volatile reads to populate this process's PTEs.
+void shm_store_prefault(void* handle, int writer) {
+  Store* s = static_cast<Store*>(handle);
+  uint8_t* begin = data_base(s);
+  uint64_t bytes = s->hdr->capacity;
+  s->prefault_thread = std::thread([s, begin, bytes, writer]() {
+    constexpr uint64_t kPage = 4096;
+    for (uint64_t off = 0; off < bytes; off += kPage) {
+      if (s->stop_prefault.load(std::memory_order_relaxed)) return;
+      auto* word = reinterpret_cast<std::atomic<uint64_t>*>(begin + off);
+      if (writer) {
+        uint64_t v = word->load(std::memory_order_relaxed);
+        word->compare_exchange_strong(v, v, std::memory_order_relaxed);
+      } else {
+        (void)word->load(std::memory_order_relaxed);
+      }
+    }
+  });
+}
+
+uint64_t shm_store_capacity(void* handle) {
+  return static_cast<Store*>(handle)->hdr->capacity;
+}
+
+uint64_t shm_store_bytes_in_use(void* handle) {
+  return static_cast<Store*>(handle)->hdr->bytes_in_use;
+}
+
+uint64_t shm_store_num_objects(void* handle) {
+  return static_cast<Store*>(handle)->hdr->num_objects;
+}
+
+// Base pointer of the mapping (Python builds a memoryview over it).
+void* shm_store_base(void* handle) {
+  return static_cast<Store*>(handle)->base;
+}
+
+uint64_t shm_store_map_size(void* handle) {
+  return static_cast<Store*>(handle)->map_size;
+}
+
+}  // extern "C"

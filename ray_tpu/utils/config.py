@@ -1,0 +1,93 @@
+"""Global runtime configuration.
+
+Counterpart of the reference's RAY_CONFIG X-macro flag table
+(src/ray/common/ray_config_def.h — 219 entries, overridable via RAY_* env vars).
+Redesigned as a typed dataclass; every field is overridable via the env var
+``RAY_TPU_<FIELD_UPPERCASE>``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+@dataclasses.dataclass
+class RayTpuConfig:
+    # --- object plane ---
+    # Objects <= this many bytes are inlined into task replies / owner memory
+    # store instead of the shared-memory store (reference:
+    # ray_config_def.h max_direct_call_object_size, 100KB).
+    max_inline_object_size: int = 100 * 1024
+    # Default shm store capacity (bytes) when not set in init(); reference
+    # sizes plasma at 30% of system memory — we default smaller and grow.
+    object_store_memory: int = 2 * 1024**3
+    # Chunk size for node-to-node object transfer (reference: 5MiB chunks in
+    # object_manager.h).
+    object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+
+    # --- scheduling ---
+    # Max worker leases requested in flight per scheduling key (reference:
+    # max_pending_lease_requests_per_scheduling_category).
+    max_pending_leases_per_key: int = 10
+    # Hybrid scheduling policy: prefer local node until its utilization
+    # crosses this threshold (reference: scheduler_spread_threshold 0.5).
+    spread_threshold: float = 0.5
+    # Top-k fraction of nodes considered by the hybrid policy (reference:
+    # scheduler_top_k_fraction).
+    scheduler_top_k_fraction: float = 0.2
+    # Idle workers kept warm per (language, runtime-env) key.
+    idle_worker_pool_size: int = 2
+    worker_start_timeout_s: float = 60.0
+
+    # --- control plane ---
+    heartbeat_interval_s: float = 1.0
+    # Node declared dead after this many missed heartbeats (reference:
+    # health_check_failure_threshold).
+    heartbeat_failure_threshold: int = 5
+    gcs_rpc_timeout_s: float = 30.0
+    # Resource-view gossip period (reference: ray_syncer 100ms).
+    resource_broadcast_interval_s: float = 0.1
+
+    # --- fault tolerance ---
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    # Exponential backoff for actor/task retry.
+    retry_backoff_initial_s: float = 0.1
+    retry_backoff_max_s: float = 10.0
+
+    # --- chaos / testing (reference: rpc_chaos.h, asio_chaos.cc) ---
+    # "method:failure_prob" comma list, e.g. "push_task:0.1,lease:0.05".
+    testing_rpc_failure: str = ""
+
+    # --- TPU ---
+    # Virtualize TPU count for tests (like TPU_VISIBLE_CHIPS).
+    tpu_visible_chips: str = ""
+
+    def __post_init__(self) -> None:
+        for f in dataclasses.fields(self):
+            env = os.environ.get(f"RAY_TPU_{f.name.upper()}")
+            if env is not None:
+                setattr(self, f.name, _parse(env, f.type))
+
+
+def _parse(value: str, typ: Any) -> Any:
+    typ = str(typ)
+    if "int" in typ:
+        return int(value)
+    if "float" in typ:
+        return float(value)
+    if "bool" in typ:
+        return value.lower() in ("1", "true", "yes")
+    return value
+
+
+_config: RayTpuConfig | None = None
+
+
+def get_config() -> RayTpuConfig:
+    global _config
+    if _config is None:
+        _config = RayTpuConfig()
+    return _config
