@@ -1,0 +1,67 @@
+"""ray_tpu — a TPU-native distributed AI runtime.
+
+Same capability surface as the reference (Ray): tasks, actors, a distributed
+object store with ownership-based reference counting, placement groups, and
+the AI libraries (train/tune/data/serve/rllib/llm) — re-designed for TPU
+(jax/XLA/pallas/pjit) rather than ported.
+"""
+
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle, ActorMethod
+from ray_tpu.api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayActorError,
+    RayTaskError,
+    RayTpuError,
+    TaskCancelledError,
+)
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorClass",
+    "ActorDiedError",
+    "ActorHandle",
+    "ActorMethod",
+    "ActorUnavailableError",
+    "GetTimeoutError",
+    "ObjectLostError",
+    "ObjectRef",
+    "RayActorError",
+    "RayTaskError",
+    "RayTpuError",
+    "RemoteFunction",
+    "TaskCancelledError",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "get",
+    "get_actor",
+    "init",
+    "is_initialized",
+    "kill",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

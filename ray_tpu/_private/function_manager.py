@@ -1,0 +1,47 @@
+"""Function/actor-class distribution via GCS KV.
+
+Counterpart of python/ray/_private/function_manager.py: the driver exports a
+cloudpickled function once (content-addressed), workers fetch + cache on first
+use. No import thread — fetch is lazy at execution time.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Any, Callable, Dict
+
+import cloudpickle
+
+
+class FunctionManager:
+    def __init__(self, gcs_call: Callable):
+        # gcs_call(method, **kwargs) -> result, synchronous.
+        self._gcs_call = gcs_call
+        self._exported: Dict[str, bool] = {}
+        self._cache: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def export(self, fn_or_class: Any, job_id_hex: str) -> str:
+        payload = cloudpickle.dumps(fn_or_class, protocol=5)
+        key = f"fn:{job_id_hex}:{hashlib.sha1(payload).hexdigest()}"
+        with self._lock:
+            if key in self._exported:
+                return key
+        self._gcs_call("kv_put", key=key, value=payload, overwrite=False)
+        with self._lock:
+            self._exported[key] = True
+            self._cache[key] = fn_or_class
+        return key
+
+    def fetch(self, key: str) -> Any:
+        with self._lock:
+            if key in self._cache:
+                return self._cache[key]
+        payload = self._gcs_call("kv_get", key=key)
+        if payload is None:
+            raise KeyError(f"function {key} not found in GCS")
+        obj = cloudpickle.loads(payload)
+        with self._lock:
+            self._cache[key] = obj
+        return obj

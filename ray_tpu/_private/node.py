@@ -1,0 +1,137 @@
+"""Node bootstrap: start/locate GCS + nodelet processes for ray_tpu.init()
+(reference: python/ray/_private/node.py:43 + services.py)."""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_port(host: str, port: int, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=1):
+                return
+        except OSError:
+            time.sleep(0.05)
+    raise TimeoutError(f"service at {host}:{port} did not come up")
+
+
+class Node:
+    """Starts a head node's processes (GCS + one nodelet) as subprocesses and
+    tears them down at exit."""
+
+    def __init__(
+        self,
+        head: bool = True,
+        gcs_address: Optional[Tuple[str, int]] = None,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: Optional[int] = None,
+        session_dir: Optional[str] = None,
+        node_name: str = "",
+    ):
+        self.head = head
+        self.session_id = f"session_{uuid.uuid4().hex[:12]}"
+        self.session_dir = session_dir or os.path.join(
+            tempfile.gettempdir(), "ray_tpu", self.session_id)
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.processes: List[subprocess.Popen] = []
+        self._env = dict(os.environ)
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        self._env["PYTHONPATH"] = repo_root + os.pathsep + self._env.get(
+            "PYTHONPATH", "")
+
+        if head:
+            gcs_port = free_port()
+            self.gcs_address = ("127.0.0.1", gcs_port)
+            self._start_process(
+                [sys.executable, "-m", "ray_tpu.core.gcs",
+                 "--host", "127.0.0.1", "--port", str(gcs_port)],
+                "gcs",
+            )
+            _wait_port(*self.gcs_address)
+        else:
+            assert gcs_address is not None
+            self.gcs_address = gcs_address
+
+        nodelet_port = free_port()
+        self.nodelet_address = ("127.0.0.1", nodelet_port)
+        cmd = [
+            sys.executable, "-m", "ray_tpu.core.nodelet",
+            "--gcs-host", self.gcs_address[0],
+            "--gcs-port", str(self.gcs_address[1]),
+            "--port", str(nodelet_port),
+            "--session-dir", self.session_dir,
+            "--node-name", node_name,
+        ]
+        if resources is not None:
+            cmd += ["--resources", json.dumps(resources)]
+        if object_store_memory:
+            cmd += ["--object-store-memory", str(object_store_memory)]
+        self._start_process(cmd, f"nodelet-{node_name or 'head'}")
+        _wait_port(*self.nodelet_address)
+        self.store_path = self._wait_store_path()
+        atexit.register(self.shutdown)
+
+    def _start_process(self, cmd: List[str], name: str) -> subprocess.Popen:
+        log = open(os.path.join(self.session_dir, "logs", f"{name}.log"), "wb")
+        proc = subprocess.Popen(cmd, env=self._env, stdout=log,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+        self.processes.append(proc)
+        return proc
+
+    def _wait_store_path(self, timeout: float = 30.0) -> str:
+        """Ask the nodelet where its object store lives."""
+        from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+        loop = EventLoopThread("bootstrap")
+        try:
+            deadline = time.monotonic() + timeout
+            while True:
+                try:
+                    client = RpcClient(*self.nodelet_address)
+                    stats = loop.run(client.call("node_stats", timeout=5))
+                    loop.run(client.close())
+                    self.node_id = stats["node_id"]
+                    return stats["store_path"]
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+        finally:
+            loop.stop()
+
+    def shutdown(self) -> None:
+        for proc in reversed(self.processes):
+            if proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 3
+        for proc in self.processes:
+            try:
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self.processes.clear()

@@ -33,7 +33,7 @@ class ObjectRef:
             return
         w = worker_mod.global_worker_or_none()
         if w is not None:
-            w.reference_counter.add_borrowed_ref(self)
+            w.ref_counter.add_borrowed_ref(self)
             self._registered = True
 
     def hex(self) -> str:
@@ -68,7 +68,7 @@ class ObjectRef:
 
             w = worker_mod.global_worker_or_none()
             if w is not None:
-                w.reference_counter.remove_local_ref(self.id)
+                w.ref_counter.remove_local_ref(self.id)
         except Exception:
             pass
 

@@ -1,0 +1,104 @@
+"""Distributed reference counting (ownership model).
+
+Counterpart of src/ray/core_worker/reference_count.h:73 — the borrowing
+protocol. Re-expressed compactly: every ObjectRef has exactly one *owner* (the
+worker that created it). Local refcounts are driven by ObjectRef
+construction/__del__; deserializing a ref registers a borrow which is reported
+to the owner in batches. The owner frees the value (memory store + shm) when
+its local count is zero and no borrowers remain.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Set, Tuple
+
+from ray_tpu._private.ids import ObjectID
+
+
+class _Record:
+    __slots__ = ("local", "owned", "borrowers", "pinned_in_shm")
+
+    def __init__(self, owned: bool):
+        self.local = 0
+        self.owned = owned
+        self.borrowers: Set[Tuple[str, int]] = set()
+        self.pinned_in_shm = False
+
+
+class ReferenceCounter:
+    def __init__(self, on_zero: Optional[Callable[[ObjectID], None]] = None):
+        self._records: Dict[ObjectID, _Record] = {}
+        self._lock = threading.Lock()
+        self._on_zero = on_zero
+        # Borrows we hold that must be reported to remote owners.
+        self._pending_borrow_reports: Dict[Tuple[str, int], Set[ObjectID]] = {}
+
+    def add_owned_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            rec = self._records.setdefault(object_id, _Record(owned=True))
+            rec.owned = True
+            rec.local += 1
+
+    def add_local_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            rec = self._records.setdefault(object_id, _Record(owned=False))
+            rec.local += 1
+
+    def add_borrowed_ref(self, ref) -> None:
+        with self._lock:
+            rec = self._records.setdefault(ref.id, _Record(owned=False))
+            rec.local += 1
+            if ref.owner_address is not None:
+                addr = tuple(ref.owner_address)
+                self._pending_borrow_reports.setdefault(addr, set()).add(ref.id)
+
+    def add_borrower(self, object_id: ObjectID, borrower: Tuple[str, int]) -> None:
+        """Owner side: a remote worker now holds a reference."""
+        with self._lock:
+            rec = self._records.setdefault(object_id, _Record(owned=True))
+            rec.borrowers.add(tuple(borrower))
+
+    def remove_borrower(self, object_id: ObjectID, borrower: Tuple[str, int]) -> None:
+        fire = False
+        with self._lock:
+            rec = self._records.get(object_id)
+            if rec is None:
+                return
+            rec.borrowers.discard(tuple(borrower))
+            fire = rec.owned and rec.local <= 0 and not rec.borrowers
+        if fire and self._on_zero:
+            self._on_zero(object_id)
+
+    def remove_local_ref(self, object_id: ObjectID) -> None:
+        fire = False
+        with self._lock:
+            rec = self._records.get(object_id)
+            if rec is None:
+                return
+            rec.local -= 1
+            if rec.local <= 0:
+                if rec.owned and not rec.borrowers:
+                    fire = True
+                    del self._records[object_id]
+                elif not rec.owned:
+                    del self._records[object_id]
+        if fire and self._on_zero:
+            self._on_zero(object_id)
+
+    def drain_borrow_reports(self) -> Dict[Tuple[str, int], Set[ObjectID]]:
+        with self._lock:
+            out = self._pending_borrow_reports
+            self._pending_borrow_reports = {}
+            return out
+
+    def num_records(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "records": len(self._records),
+                "owned": sum(1 for r in self._records.values() if r.owned),
+            }

@@ -283,27 +283,37 @@ class RpcClient:
                 self._writer = None
                 self._fail_all(ConnectionLost(f"connection to {self.name} lost"))
 
-    async def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
+    async def start_call(self, method: str, **kwargs) -> "asyncio.Future":
+        """Write the request and return the reply future without awaiting it —
+        lets a caller pipeline ordered requests (actor submitter)."""
         self._chaos.maybe_fail(method)
         if self._writer is None:
-            await self.connect()
+            try:
+                await self.connect()
+            except OSError as e:
+                raise ConnectionLost(str(e)) from e
         msg_id = next(self._msg_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        fut._rpc_msg_id = msg_id  # type: ignore[attr-defined]
         self._pending[msg_id] = fut
         try:
             async with self._write_lock:
                 await _write_frame(
                     self._writer, KIND_REQUEST, msg_id, (method, kwargs)
                 )
-        except (ConnectionResetError, BrokenPipeError, AttributeError) as e:
+        except (ConnectionResetError, BrokenPipeError, AttributeError, OSError) as e:
             self._pending.pop(msg_id, None)
             raise ConnectionLost(str(e)) from e
+        return fut
+
+    async def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
+        fut = await self.start_call(method, **kwargs)
         if timeout is None:
             timeout = get_config().gcs_rpc_timeout_s
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
-            self._pending.pop(msg_id, None)
+            self._pending.pop(fut._rpc_msg_id, None)  # type: ignore[attr-defined]
             raise
 
     async def _reset_connection(self) -> None:
@@ -342,9 +352,15 @@ class RpcClient:
     async def notify(self, method: str, **kwargs) -> None:
         self._chaos.maybe_fail(method)
         if self._writer is None:
-            await self.connect()
-        async with self._write_lock:
-            await _write_frame(self._writer, KIND_NOTIFY, 0, (method, kwargs))
+            try:
+                await self.connect()
+            except OSError as e:
+                raise ConnectionLost(str(e)) from e
+        try:
+            async with self._write_lock:
+                await _write_frame(self._writer, KIND_NOTIFY, 0, (method, kwargs))
+        except (ConnectionResetError, BrokenPipeError, AttributeError, OSError) as e:
+            raise ConnectionLost(str(e)) from e
 
 
 class EventLoopThread:

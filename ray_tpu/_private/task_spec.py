@@ -1,0 +1,125 @@
+"""Task specifications and the resource model.
+
+Counterparts: TaskSpecification (src/ray/common/task/task_spec.h),
+ResourceSet (src/ray/common/scheduling/resource_set.h). The reference uses
+fixed-point arithmetic for fractional resources; we keep float resources with
+a quantization helper (resolution 1e-4, same as the reference's FixedPoint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, ObjectID, PlacementGroupID, TaskID
+
+RESOURCE_QUANTUM = 1e-4
+
+
+def quantize(v: float) -> float:
+    return round(v / RESOURCE_QUANTUM) * RESOURCE_QUANTUM
+
+
+class ResourceSet(dict):
+    """{"CPU": 1.0, "TPU": 4.0, "TPU-v5e-8-head": 1.0, ...}; values > 0."""
+
+    def __init__(self, mapping: Optional[Dict[str, float]] = None):
+        super().__init__()
+        for k, v in (mapping or {}).items():
+            if v:
+                self[k] = quantize(float(v))
+
+    def fits_in(self, avail: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) + RESOURCE_QUANTUM / 2 >= v for k, v in self.items())
+
+    def add_to(self, avail: Dict[str, float]) -> None:
+        for k, v in self.items():
+            avail[k] = avail.get(k, 0.0) + v
+
+    def subtract_from(self, avail: Dict[str, float]) -> None:
+        for k, v in self.items():
+            avail[k] = avail.get(k, 0.0) - v
+
+    def key(self) -> Tuple:
+        return tuple(sorted(self.items()))
+
+
+class TaskType(enum.Enum):
+    NORMAL_TASK = 0
+    ACTOR_CREATION_TASK = 1
+    ACTOR_TASK = 2
+
+
+class SchedulingStrategy:
+    """Base for scheduling strategies (reference:
+    python/ray/util/scheduling_strategies.py)."""
+
+
+@dataclasses.dataclass
+class DefaultStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclasses.dataclass
+class SpreadStrategy(SchedulingStrategy):
+    pass
+
+
+@dataclasses.dataclass
+class NodeAffinityStrategy(SchedulingStrategy):
+    node_id: str
+    soft: bool = False
+
+
+@dataclasses.dataclass
+class PlacementGroupStrategy(SchedulingStrategy):
+    placement_group_id: bytes
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    """The full description of one task invocation, shipped to the executor."""
+
+    task_id: TaskID
+    job_id: JobID
+    task_type: TaskType
+    # Key into GCS KV where the pickled function / actor class lives.
+    function_key: str
+    # Human-readable, e.g. "module.fn" — for errors/state API.
+    function_name: str
+    # Positional and keyword args, each either ("value", SerializedObject)
+    # or ("ref", ObjectID, owner_address).
+    args: List[Any]
+    kwargs: Dict[str, Any]
+    num_returns: int
+    resources: ResourceSet
+    scheduling_strategy: SchedulingStrategy
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    # Owner info: the worker that must be told about results.
+    owner_address: Optional[Tuple[str, int]] = None
+    # Actor fields.
+    actor_id: Optional[ActorID] = None
+    actor_method_name: str = ""
+    seq_no: int = 0
+    max_concurrency: int = 1
+    concurrency_group: str = ""
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    # Runtime env (serialized dict) — hashed for worker-pool keying.
+    runtime_env: Optional[Dict[str, Any]] = None
+    placement_group_id: Optional[PlacementGroupID] = None
+
+    def scheduling_key(self) -> Tuple:
+        """Lease-reuse key (reference: SchedulingKey in
+        normal_task_submitter.h:44 — resource shape + runtime env)."""
+        env_key = repr(sorted((self.runtime_env or {}).items()))
+        return (self.resources.key(), env_key, type(self.scheduling_strategy).__name__)
+
+    def return_ids(self) -> List[ObjectID]:
+        return [
+            ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
+        ]

@@ -1,0 +1,1042 @@
+"""The per-process worker runtime — counterpart of src/ray/core_worker/
+(CoreWorker, core_worker.h:166) plus the Cython bridge (_raylet.pyx §2.2).
+
+One Worker instance per process (driver or executor). It owns:
+- an EventLoopThread hosting this process's RpcServer (direct worker↔worker
+  task pushes and owner↔borrower object resolution),
+- the owner memory store (small objects) + shm store client (large objects),
+- the submission side: TaskManager (retries/lineage), lease pools keyed by
+  SchedulingKey (reference: normal_task_submitter.h:44-58), actor submitters
+  with per-handle ordering,
+- the execution side: task/actor execution on executor threads, async-actor
+  coroutines on the event loop (reference: transport/fiber.h → here plain
+  asyncio).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import os
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.reference_counter import ReferenceCounter
+from ray_tpu._private.rpc import (
+    ConnectionLost,
+    EventLoopThread,
+    RemoteError,
+    RpcClient,
+    RpcServer,
+)
+from ray_tpu._private.task_manager import TaskManager
+from ray_tpu._private.task_spec import (
+    DefaultStrategy,
+    PlacementGroupStrategy,
+    ResourceSet,
+    TaskSpec,
+    TaskType,
+)
+from ray_tpu.core.object_store import MemoryStore, SharedMemoryStore
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTaskError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+from ray_tpu.utils.config import get_config
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_global_worker: Optional["Worker"] = None
+_global_lock = threading.Lock()
+
+
+def global_worker() -> "Worker":
+    if _global_worker is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first")
+    return _global_worker
+
+
+def global_worker_or_none() -> Optional["Worker"]:
+    return _global_worker
+
+
+def set_global_worker(w: Optional["Worker"]) -> None:
+    global _global_worker
+    with _global_lock:
+        _global_worker = w
+
+
+class ShmMarker:
+    """Memory-store placeholder meaning 'value lives in the shm store of
+    node_id'."""
+
+    __slots__ = ("node_id",)
+
+    def __init__(self, node_id: bytes):
+        self.node_id = node_id
+
+
+class LeasePool:
+    """Leased-worker pool for one SchedulingKey; pipelines queued tasks onto
+    leased workers and returns leases when drained (reference:
+    NormalTaskSubmitter lease pooling + ReportWorkerBacklog)."""
+
+    def __init__(self, worker: "Worker", sched_key: Tuple, spec_template: TaskSpec):
+        self.worker = worker
+        self.sched_key = sched_key
+        self.resources = dict(spec_template.resources)
+        self.runtime_env = spec_template.runtime_env
+        self.strategy = spec_template.scheduling_strategy
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self.num_leased = 0
+        self.requesting = 0
+
+    def maybe_scale_up(self) -> None:
+        cfg = get_config()
+        want = min(self.queue.qsize(), cfg.max_pending_leases_per_key)
+        while self.num_leased + self.requesting < max(1, want):
+            self.requesting += 1
+            asyncio.ensure_future(self._acquire_and_pump())
+
+    async def _acquire_and_pump(self) -> None:
+        try:
+            pg_bundle = None
+            if isinstance(self.strategy, PlacementGroupStrategy):
+                pg_bundle = (self.strategy.placement_group_id,
+                             max(self.strategy.bundle_index, 0))
+            lease = await self.worker.nodelet_client.call(
+                "lease_worker",
+                resources=self.resources,
+                runtime_env=self.runtime_env,
+                lifetime="task",
+                pg_bundle=pg_bundle,
+                timeout=get_config().worker_start_timeout_s + 5,
+            )
+        except Exception as e:
+            logger.warning("lease request failed: %r", e)
+            self.requesting -= 1
+            # A transient RPC failure must not strand queued tasks: back off
+            # and retry the scale-up, same as the resources-busy branch.
+            if not self.queue.empty():
+                await asyncio.sleep(get_config().retry_backoff_initial_s)
+                self.maybe_scale_up()
+            return
+        self.requesting -= 1
+        if not lease.get("ok"):
+            # Resources busy — tasks stay queued; an existing lease will drain
+            # them, or a later submit retries the scale-up.
+            if self.num_leased == 0 and not self.queue.empty():
+                await asyncio.sleep(0.5)
+                self.maybe_scale_up()
+            return
+        self.num_leased += 1
+        worker_id = lease["worker_id"]
+        addr = tuple(lease["worker_address"])
+        client = RpcClient(*addr, name="leased-worker")
+        try:
+            while True:
+                try:
+                    spec: TaskSpec = self.queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                worker_alive = await self.worker.push_task_to(client, addr, spec)
+                if not worker_alive:
+                    # The leased worker died; drop the lease — any retry was
+                    # re-queued and will get a fresh worker.
+                    break
+        finally:
+            self.num_leased -= 1
+            await client.close()
+            try:
+                await self.worker.nodelet_client.call(
+                    "return_worker", worker_id=worker_id)
+            except Exception:
+                pass
+            if not self.queue.empty():
+                self.maybe_scale_up()
+
+
+class ActorSubmitter:
+    """Per-actor ordered submission (reference: actor_task_submitter.h:75).
+
+    A single pump coroutine drains a FIFO queue so request *writes* hit the
+    wire in seq_no order; replies are awaited concurrently so an async actor
+    still sees pipelined calls.
+    """
+
+    def __init__(self, worker: "Worker", actor_id: ActorID):
+        self.worker = worker
+        self.actor_id = actor_id
+        self.client: Optional[RpcClient] = None
+        self.address: Optional[Tuple[str, int]] = None
+        self.queue: asyncio.Queue = asyncio.Queue()
+        self._pump_task: Optional[asyncio.Task] = None
+
+    def enqueue(self, spec: TaskSpec, max_task_retries: int) -> None:
+        self.queue.put_nowait((spec, max_task_retries, 0))
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.ensure_future(self._pump())
+
+    async def _pump(self) -> None:
+        while not self.queue.empty():
+            spec, retries, attempt = self.queue.get_nowait()
+            try:
+                client = await self._ensure_client()
+                fut = await client.start_call("push_actor_task",
+                                              spec=ser_spec(spec))
+            except (ConnectionLost, asyncio.TimeoutError) as e:
+                await self._on_send_failure(spec, retries, attempt, e)
+                continue
+            except (ActorDiedError, ActorUnavailableError) as e:
+                self.worker.task_manager.fail_permanently(
+                    spec.task_id, ser.serialize_error(e))
+                continue
+            asyncio.ensure_future(
+                self._handle_reply(spec, retries, attempt, fut))
+
+    async def _on_send_failure(self, spec: TaskSpec, retries: int,
+                               attempt: int, exc: BaseException) -> None:
+        self.reset()
+        if attempt < retries:
+            await asyncio.sleep(get_config().retry_backoff_initial_s)
+            self.queue.put_nowait((spec, retries, attempt + 1))
+            return
+        # Distinguish dead vs transient for the error type.
+        try:
+            info = await self.worker.gcs_client.call(
+                "get_actor", actor_id=self.actor_id.binary())
+        except Exception:
+            info = None
+        if info is not None and info["state"] == "DEAD":
+            err: BaseException = ActorDiedError(
+                f"actor {self.actor_id} died: {info['death_cause']}")
+        else:
+            err = ActorUnavailableError(
+                f"actor {self.actor_id} unreachable: {exc!r}")
+        self.worker.task_manager.fail_permanently(
+            spec.task_id, ser.serialize_error(err))
+
+    async def _handle_reply(self, spec: TaskSpec, retries: int, attempt: int,
+                            fut: "asyncio.Future") -> None:
+        try:
+            reply = await asyncio.wait_for(fut, 86400.0)
+        except (ConnectionLost, RemoteError, asyncio.TimeoutError) as e:
+            await self._on_send_failure(spec, retries, attempt, e)
+            if self._pump_task is None or self._pump_task.done():
+                self._pump_task = asyncio.ensure_future(self._pump())
+            return
+        await self.worker.handle_task_reply(spec, reply)
+
+    async def _ensure_client(self) -> RpcClient:
+        if self.client is not None:
+            return self.client
+        cfg = get_config()
+        deadline = time.monotonic() + cfg.worker_start_timeout_s
+        while True:
+            info = await self.worker.gcs_client.call(
+                "get_actor", actor_id=self.actor_id.binary())
+            if info is None:
+                raise ActorDiedError(f"actor {self.actor_id} was never created")
+            if info["state"] == "ALIVE" and info["address"]:
+                self.address = tuple(info["address"])
+                self.client = RpcClient(*self.address, name="actor")
+                return self.client
+            if info["state"] == "DEAD":
+                raise ActorDiedError(
+                    f"actor {self.actor_id} is dead: {info['death_cause']}")
+            if time.monotonic() > deadline:
+                raise ActorUnavailableError(
+                    f"actor {self.actor_id} stuck in {info['state']}")
+            await asyncio.sleep(0.05)
+
+    def reset(self) -> None:
+        client, self.client, self.address = self.client, None, None
+        if client is not None:
+            asyncio.ensure_future(client.close())
+
+
+def ser_spec(spec: TaskSpec) -> bytes:
+    import pickle
+
+    return pickle.dumps(spec, protocol=5)
+
+
+def deser_spec(data: bytes) -> TaskSpec:
+    import pickle
+
+    return pickle.loads(data)
+
+
+class Worker:
+    def __init__(
+        self,
+        mode: str,  # "driver" | "worker"
+        gcs_address: Tuple[str, int],
+        nodelet_address: Tuple[str, int],
+        store_path: str,
+        session_dir: str,
+        job_id: Optional[JobID] = None,
+        node_id: Optional[NodeID] = None,
+        worker_id: Optional[WorkerID] = None,
+    ):
+        self.mode = mode
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id = node_id or NodeID.nil()
+        self.session_dir = session_dir
+        self.loop_thread = EventLoopThread(f"ray_tpu_{mode}_io")
+        self.loop = self.loop_thread.loop
+        self.memory_store = MemoryStore(self.loop)
+        self.shm = SharedMemoryStore(store_path)
+        self.ref_counter = ReferenceCounter(on_zero=self._on_owned_ref_zero)
+        self.task_manager = TaskManager(self._store_task_result)
+        self.server = RpcServer()
+        self.address: Optional[Tuple[str, int]] = None
+        self.gcs_address = gcs_address
+        self.nodelet_address = nodelet_address
+        self.gcs_client: Optional[RpcClient] = None
+        self.nodelet_client: Optional[RpcClient] = None
+        self.job_id = job_id or JobID.from_int(0)
+        self.function_manager = FunctionManager(self._gcs_call_sync)
+        self._put_counter = 0
+        self._put_lock = threading.Lock()
+        self._task_counter_lock = threading.Lock()
+        self._lease_pools: Dict[Tuple, LeasePool] = {}
+        self._actor_submitters: Dict[ActorID, ActorSubmitter] = {}
+        self._actor_seq_nos: Dict[ActorID, int] = {}
+        # Execution side.
+        self._task_executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="task_exec")
+        self._actor_instance: Any = None
+        self._actor_creation_spec: Optional[TaskSpec] = None
+        self._actor_executors: Dict[str, concurrent.futures.ThreadPoolExecutor] = {}
+        self._actor_is_async = False
+        self._running_tasks: Dict[TaskID, Any] = {}
+        self._cancelled_tasks: set = set()
+        self.connected = False
+        self._shutdown = False
+        # The task currently executing in this process (execution context).
+        self._current_task_id: Optional[TaskID] = None
+
+    # ------------------------------------------------------------------
+    # Bootstrap
+    # ------------------------------------------------------------------
+    def connect(self) -> None:
+        async def _setup():
+            self.address = await self.server.start()
+            self._register_handlers()
+            self.gcs_client = RpcClient(*self.gcs_address, name="gcs")
+            self.nodelet_client = RpcClient(*self.nodelet_address, name="nodelet")
+            await self.gcs_client.connect()
+            await self.nodelet_client.connect()
+            asyncio.ensure_future(self._borrow_report_loop())
+
+        self.loop_thread.run(_setup())
+        self.connected = True
+        set_global_worker(self)
+
+    def disconnect(self) -> None:
+        if not self.connected:
+            return
+        self._shutdown = True
+
+        async def _teardown():
+            if self.gcs_client:
+                await self.gcs_client.close()
+            if self.nodelet_client:
+                await self.nodelet_client.close()
+            await self.server.stop()
+
+        try:
+            self.loop_thread.run(_teardown(), timeout=5)
+        except Exception:
+            pass
+        self.connected = False
+        set_global_worker(None)
+        self._task_executor.shutdown(wait=False)
+        self.loop_thread.stop()
+
+    def _register_handlers(self) -> None:
+        s = self.server
+        s.register("push_task", self._rpc_push_task)
+        s.register("create_actor", self._rpc_create_actor)
+        s.register("push_actor_task", self._rpc_push_actor_task)
+        s.register("get_object", self._rpc_get_object)
+        s.register("wait_object", self._rpc_wait_object)
+        s.register("add_borrows", self._rpc_add_borrows)
+        s.register("remove_borrows", self._rpc_remove_borrows)
+        s.register("free_objects", self._rpc_free_objects)
+        s.register("cancel_task", self._rpc_cancel_task)
+        s.register("exit_worker", self._rpc_exit_worker)
+        s.register("ping", self._rpc_ping)
+
+    def _gcs_call_sync(self, method: str, **kwargs) -> Any:
+        return self.loop_thread.run(
+            self.gcs_client.call_retrying(method, **kwargs))
+
+    # ------------------------------------------------------------------
+    # Owned-object lifecycle
+    # ------------------------------------------------------------------
+    def _on_owned_ref_zero(self, object_id: ObjectID) -> None:
+        self.memory_store.delete(object_id)
+        try:
+            self.shm.delete(object_id)
+        except Exception:
+            pass
+
+    def _store_task_result(self, object_id: ObjectID, result: Any) -> None:
+        """TaskManager completion callback: result is SerializedObject or
+        ShmMarker."""
+        self.memory_store.put(object_id, result)
+
+    # ------------------------------------------------------------------
+    # Public API: put / get / wait
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        with self._put_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        task_id = TaskID.for_task(self.job_id)
+        object_id = ObjectID.for_put(task_id, idx)
+        obj = ser.serialize(value)
+        cfg = get_config()
+        if obj.total_bytes() > cfg.max_inline_object_size:
+            self.shm.put_serialized(object_id, obj)
+            self.memory_store.put(object_id, ShmMarker(self.node_id.binary()))
+        else:
+            self.memory_store.put(object_id, obj)
+        ref = ObjectRef(object_id, owner_address=self.address)
+        self.ref_counter.add_owned_ref(object_id)
+        return ref
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        coro = self._get_async(refs, timeout)
+        outer = None if timeout is None else timeout + 5
+        return self.loop_thread.run(coro, timeout=outer)
+
+    async def _get_async(self, refs: List[ObjectRef],
+                         timeout: Optional[float]) -> List[Any]:
+        results = await asyncio.gather(
+            *[self._resolve_ref(r, timeout) for r in refs])
+        out = []
+        for obj in results:
+            value, is_error = ser.deserialize_or_error(obj)
+            if is_error:
+                raise value
+            out.append(value)
+        return out
+
+    async def _resolve_ref(self, ref: ObjectRef,
+                           timeout: Optional[float]) -> ser.SerializedObject:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # 1. Local shm (covers all objects materialized on this node).
+        obj = self.shm.get_serialized(ref.id)
+        if obj is not None:
+            return obj
+        # 2. Owner memory store (locally-owned values or markers).
+        entry = self.memory_store.get_if_exists(ref.id)
+        if entry is None and (ref.owner_address is None
+                              or tuple(ref.owner_address) == self.address):
+            # We own it but it is still pending — wait for task completion.
+            try:
+                entry = await self.memory_store.get(
+                    ref.id, None if deadline is None
+                    else max(0.0, deadline - time.monotonic()))
+            except asyncio.TimeoutError:
+                raise GetTimeoutError(f"timed out resolving {ref}")
+        if entry is not None:
+            return await self._materialize(ref.id, entry, deadline)
+        # 3. Borrowed: ask the owner.
+        return await self._resolve_from_owner(ref, deadline)
+
+    async def _materialize(self, object_id: ObjectID, entry: Any,
+                           deadline: Optional[float]) -> ser.SerializedObject:
+        if isinstance(entry, ser.SerializedObject):
+            return entry
+        assert isinstance(entry, ShmMarker)
+        if entry.node_id == self.node_id.binary() or self.shm.contains(object_id):
+            obj = self.shm.get_serialized(object_id)
+            if obj is not None:
+                return obj
+            raise ObjectLostError(f"object {object_id} missing from local shm "
+                                  "(evicted?)")
+        return await self._fetch_remote(object_id, entry.node_id, deadline)
+
+    async def _fetch_remote(self, object_id: ObjectID, node_id: bytes,
+                            deadline: Optional[float]) -> ser.SerializedObject:
+        """Pull an object from another node's store via its nodelet and cache
+        it in local shm (reference: ObjectManager Pull, C12)."""
+        nodes = await self.gcs_client.call("list_nodes")
+        target = next((n for n in nodes if n["node_id"] == node_id), None)
+        if target is None:
+            raise ObjectLostError(f"node for object {object_id} is gone")
+        client = RpcClient(*target["address"], name="fetch")
+        try:
+            reply = await client.call(
+                "fetch_object", object_id=object_id.binary(),
+                timeout=None if deadline is None else deadline - time.monotonic())
+        finally:
+            await client.close()
+        if reply is None:
+            raise ObjectLostError(f"object {object_id} not found on owner node")
+        obj = ser.SerializedObject(reply["metadata"], reply["buffers"], [])
+        try:
+            self.shm.put_serialized(object_id, obj)
+        except Exception:
+            pass
+        return obj
+
+    async def _resolve_from_owner(
+        self, ref: ObjectRef, deadline: Optional[float]
+    ) -> ser.SerializedObject:
+        owner = tuple(ref.owner_address)
+        client = RpcClient(*owner, name="owner")
+        try:
+            while True:
+                t = None if deadline is None else max(
+                    0.1, deadline - time.monotonic())
+                try:
+                    reply = await client.call(
+                        "get_object", object_id=ref.id.binary(),
+                        borrower=self.address, timeout=t)
+                except asyncio.TimeoutError:
+                    raise GetTimeoutError(f"timed out resolving {ref}")
+                except (ConnectionLost, RemoteError) as e:
+                    raise ObjectLostError(
+                        f"owner of {ref} unreachable: {e!r}") from e
+                kind = reply["kind"]
+                if kind == "inline":
+                    return ser.SerializedObject(
+                        reply["metadata"], reply["buffers"], [])
+                if kind == "shm":
+                    if self.shm.contains(ref.id):
+                        return self.shm.get_serialized(ref.id)
+                    return await self._fetch_remote(
+                        ref.id, reply["node_id"], deadline)
+                if kind == "pending":
+                    await asyncio.sleep(0.02)
+                    continue
+                raise ObjectLostError(f"object {ref} lost: {reply.get('error')}")
+        finally:
+            await client.close()
+
+    def wait(self, refs: List[ObjectRef], num_returns: int,
+             timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        async def _wait():
+            tasks = {
+                asyncio.ensure_future(self._resolve_ref(r, timeout)): r
+                for r in refs
+            }
+            ready: List[ObjectRef] = []
+            pending = set(tasks)
+            deadline = None if timeout is None else time.monotonic() + timeout
+            while pending and len(ready) < num_returns:
+                t = None if deadline is None else max(0.0, deadline - time.monotonic())
+                done, pending = await asyncio.wait(
+                    pending, timeout=t, return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break
+                for d in done:
+                    # Ready = the object is fetchable. Application errors are
+                    # stored as serialized error *values*, so resolution still
+                    # succeeds for them; an exception here is an infrastructure
+                    # failure (timeout, lost object, dead owner) = not ready.
+                    if d.exception() is None:
+                        ready.append(tasks[d])
+            for p in pending:
+                p.cancel()
+            ready_set = {r.id for r in ready}
+            not_ready = [r for r in refs if r.id not in ready_set]
+            return ready, not_ready
+
+        return self.loop_thread.run(_wait())
+
+    def get_async(self, ref: ObjectRef) -> concurrent.futures.Future:
+        return self.loop_thread.run_async(self._get_one(ref))
+
+    async def _get_one(self, ref: ObjectRef) -> Any:
+        obj = await self._resolve_ref(ref, None)
+        value, is_error = ser.deserialize_or_error(obj)
+        if is_error:
+            raise value
+        return value
+
+    async def await_ref(self, ref: ObjectRef) -> Any:
+        """Used by `await ref` inside async actors (same loop)."""
+        return await self._get_one(ref)
+
+    # ------------------------------------------------------------------
+    # Submission: normal tasks
+    # ------------------------------------------------------------------
+    def _process_args(self, args: tuple, kwargs: dict) -> Tuple[list, dict]:
+        cfg = get_config()
+
+        def conv(a: Any) -> Any:
+            # Ref args carry the ObjectRef object itself: the pending-task
+            # spec pins it (owner keeps the value alive until the task
+            # completes — reference: TaskManager lineage pinning), and
+            # pickling the ref on the wire registers a borrow executor-side.
+            if isinstance(a, ObjectRef):
+                return ("ref", a)
+            obj = ser.serialize(a)
+            if obj.total_bytes() > cfg.max_inline_object_size:
+                return ("ref", self.put(a))
+            return ("value", obj)
+
+        return [conv(a) for a in args], {k: conv(v) for k, v in kwargs.items()}
+
+    def submit_task(
+        self,
+        fn: Any,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        scheduling_strategy: Any = None,
+        max_retries: Optional[int] = None,
+        retry_exceptions: bool = False,
+        runtime_env: Optional[Dict[str, Any]] = None,
+        function_name: str = "",
+    ) -> List[ObjectRef]:
+        fn_key = self.function_manager.export(fn, self.job_id.hex())
+        p_args, p_kwargs = self._process_args(args, kwargs)
+        cfg = get_config()
+        spec = TaskSpec(
+            task_id=TaskID.for_task(self.job_id),
+            job_id=self.job_id,
+            task_type=TaskType.NORMAL_TASK,
+            function_key=fn_key,
+            function_name=function_name or getattr(fn, "__name__", "fn"),
+            args=p_args,
+            kwargs=p_kwargs,
+            num_returns=num_returns,
+            resources=ResourceSet(resources or {"CPU": 1.0}),
+            scheduling_strategy=scheduling_strategy or DefaultStrategy(),
+            max_retries=cfg.task_max_retries if max_retries is None else max_retries,
+            retry_exceptions=retry_exceptions,
+            owner_address=self.address,
+            runtime_env=runtime_env,
+        )
+        return_ids = self.task_manager.add_pending(spec)
+        refs = []
+        for oid in return_ids:
+            self.ref_counter.add_owned_ref(oid)
+            refs.append(ObjectRef(oid, owner_address=self.address))
+
+        def _enqueue():
+            pool = self._lease_pools.get(spec.scheduling_key())
+            if pool is None:
+                pool = LeasePool(self, spec.scheduling_key(), spec)
+                self._lease_pools[spec.scheduling_key()] = pool
+            pool.queue.put_nowait(spec)
+            pool.maybe_scale_up()
+
+        self.loop.call_soon_threadsafe(_enqueue)
+        return refs
+
+    async def push_task_to(self, client: RpcClient, addr: Tuple[str, int],
+                           spec: TaskSpec) -> bool:
+        """Push one task to a leased worker. Returns False when the worker is
+        unusable (connection lost) so the caller drops the lease."""
+        self.task_manager.mark_inflight(spec.task_id, addr)
+        try:
+            reply = await client.call("push_task", spec=ser_spec(spec),
+                                      timeout=86400.0)
+        except (ConnectionLost, RemoteError, asyncio.TimeoutError, OSError) as e:
+            retry_spec = self.task_manager.fail_or_retry(spec.task_id)
+            if retry_spec is not None:
+                logger.info("retrying task %s after %r", spec.task_id, e)
+                pool = self._lease_pools.get(spec.scheduling_key())
+                if pool is not None:
+                    pool.queue.put_nowait(retry_spec)
+                    pool.maybe_scale_up()
+            else:
+                err = WorkerCrashedError(
+                    f"task {spec.function_name} failed: worker died ({e!r})")
+                self.task_manager.fail_permanently(
+                    spec.task_id, ser.serialize_error(err))
+            return not isinstance(e, (ConnectionLost, OSError))
+        await self.handle_task_reply(spec, reply)
+        return True
+
+    async def handle_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
+        if reply.get("cancelled"):
+            self.task_manager.fail_permanently(
+                spec.task_id,
+                ser.serialize_error(TaskCancelledError(str(spec.task_id))))
+            return
+        results = []
+        for item in reply["results"]:
+            kind = item[0]
+            if kind == "inline":
+                results.append(ser.SerializedObject(item[1], item[2], []))
+            elif kind == "shm":
+                results.append(ShmMarker(item[1]))
+            elif kind == "error":
+                err_obj = ser.SerializedObject(ser.METADATA_ERROR, [item[1]], [])
+                if spec.retry_exceptions:
+                    retry_spec = self.task_manager.fail_or_retry(spec.task_id)
+                    if retry_spec is not None:
+                        pool = self._lease_pools.get(spec.scheduling_key())
+                        if pool is not None:
+                            pool.queue.put_nowait(retry_spec)
+                            pool.maybe_scale_up()
+                        return
+                results.append(err_obj)
+        self.task_manager.complete(spec.task_id, results)
+
+    # ------------------------------------------------------------------
+    # Submission: actors
+    # ------------------------------------------------------------------
+    def create_actor(
+        self,
+        cls: Any,
+        args: tuple,
+        kwargs: dict,
+        resources: Optional[Dict[str, float]] = None,
+        name: str = "",
+        max_restarts: int = 0,
+        max_task_retries: int = 0,
+        max_concurrency: int = 1,
+        detached: bool = False,
+        runtime_env: Optional[Dict[str, Any]] = None,
+        scheduling_strategy: Any = None,
+    ) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        cls_key = self.function_manager.export(cls, self.job_id.hex())
+        p_args, p_kwargs = self._process_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_creation(actor_id),
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            function_key=cls_key,
+            function_name=getattr(cls, "__name__", "Actor") + ".__init__",
+            args=p_args,
+            kwargs=p_kwargs,
+            num_returns=0,
+            resources=ResourceSet(resources or {"CPU": 1.0}),
+            scheduling_strategy=scheduling_strategy or DefaultStrategy(),
+            owner_address=self.address,
+            actor_id=actor_id,
+            max_concurrency=max_concurrency,
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            runtime_env=runtime_env,
+        )
+        reply = self.loop_thread.run(
+            self.gcs_client.call_retrying(
+                "register_actor",
+                actor_id=actor_id.binary(),
+                creation_spec=ser_spec(spec),
+                name=name,
+                max_restarts=max_restarts,
+                detached=detached,
+            )
+        )
+        if not reply.get("ok"):
+            raise ValueError(reply.get("error", "actor registration failed"))
+        return actor_id
+
+    def submit_actor_task(
+        self,
+        actor_id: ActorID,
+        method_name: str,
+        args: tuple,
+        kwargs: dict,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+        concurrency_group: str = "",
+    ) -> List[ObjectRef]:
+        with self._task_counter_lock:
+            seq = self._actor_seq_nos.get(actor_id, 0)
+            self._actor_seq_nos[actor_id] = seq + 1
+        p_args, p_kwargs = self._process_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(actor_id, seq),
+            job_id=self.job_id,
+            task_type=TaskType.ACTOR_TASK,
+            function_key="",
+            function_name=method_name,
+            args=p_args,
+            kwargs=p_kwargs,
+            num_returns=num_returns,
+            resources=ResourceSet({}),
+            scheduling_strategy=DefaultStrategy(),
+            owner_address=self.address,
+            actor_id=actor_id,
+            actor_method_name=method_name,
+            seq_no=seq,
+            concurrency_group=concurrency_group,
+        )
+        return_ids = self.task_manager.add_pending(spec)
+        refs = []
+        for oid in return_ids:
+            self.ref_counter.add_owned_ref(oid)
+            refs.append(ObjectRef(oid, owner_address=self.address))
+
+        def _submit():
+            sub = self._actor_submitters.get(actor_id)
+            if sub is None:
+                sub = ActorSubmitter(self, actor_id)
+                self._actor_submitters[actor_id] = sub
+            sub.enqueue(spec, max_task_retries)
+
+        self.loop.call_soon_threadsafe(_submit)
+        return refs
+
+    # ------------------------------------------------------------------
+    # Execution side (runs in worker processes)
+    # ------------------------------------------------------------------
+    async def _rpc_push_task(self, spec: bytes) -> Dict[str, Any]:
+        task_spec = deser_spec(spec)
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._task_executor, self._execute_task_sync, task_spec)
+
+    async def _rpc_create_actor(self, creation_spec: bytes) -> Dict[str, Any]:
+        spec = deser_spec(creation_spec)
+        # The actor __init__ runs on the actor executor thread, NOT on the
+        # event loop: creation fetches the class from GCS and resolves args,
+        # both of which block on loop-driven IO (deadlock if run on the loop).
+        self._actor_executors[""] = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, spec.max_concurrency), thread_name_prefix="actor")
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            self._actor_executors[""], self._create_actor_sync, spec)
+
+    def _create_actor_sync(self, spec: TaskSpec) -> Dict[str, Any]:
+        try:
+            cls = self.function_manager.fetch(spec.function_key)
+            args, kwargs = self._resolve_spec_args_sync(spec)
+            instance = cls(*args, **kwargs)
+            self._actor_instance = instance
+            self._actor_creation_spec = spec
+            self._actor_is_async = any(
+                asyncio.iscoroutinefunction(getattr(cls, m, None))
+                for m in dir(cls) if not m.startswith("__")
+            )
+            return {"ok": True}
+        except BaseException as e:
+            logger.exception("actor creation failed")
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    async def _rpc_push_actor_task(self, spec: bytes) -> Dict[str, Any]:
+        task_spec = deser_spec(spec)
+        if self._actor_instance is None:
+            return {"results": [self._error_result(
+                ActorDiedError("actor instance not initialized"))] *
+                max(1, task_spec.num_returns)}
+        method = getattr(self._actor_instance, task_spec.actor_method_name, None)
+        if method is None:
+            return {"results": [self._error_result(AttributeError(
+                f"actor has no method {task_spec.actor_method_name!r}"))] *
+                max(1, task_spec.num_returns)}
+        if asyncio.iscoroutinefunction(method):
+            args, kwargs = await self._resolve_spec_args(task_spec)
+            try:
+                self._current_task_id = task_spec.task_id
+                result = await method(*args, **kwargs)
+                return {"results": self._pack_results(task_spec, result)}
+            except BaseException as e:  # noqa: BLE001
+                return {"results": [self._error_result(e)] *
+                        max(1, task_spec.num_returns)}
+            finally:
+                self._current_task_id = None
+        loop = asyncio.get_running_loop()
+        executor = self._actor_executors.get(
+            task_spec.concurrency_group) or self._actor_executors[""]
+        return await loop.run_in_executor(
+            executor, self._execute_actor_task_sync, task_spec, method)
+
+    def _execute_actor_task_sync(self, spec: TaskSpec, method: Any) -> Dict[str, Any]:
+        try:
+            args, kwargs = self._resolve_spec_args_sync(spec)
+            self._current_task_id = spec.task_id
+            result = method(*args, **kwargs)
+            return {"results": self._pack_results(spec, result)}
+        except BaseException as e:  # noqa: BLE001
+            return {"results": [self._error_result(e)] * max(1, spec.num_returns)}
+        finally:
+            self._current_task_id = None
+
+    def _execute_task_sync(self, spec: TaskSpec) -> Dict[str, Any]:
+        if spec.task_id in self._cancelled_tasks:
+            self._cancelled_tasks.discard(spec.task_id)
+            return {"cancelled": True, "results": []}
+        try:
+            fn = self.function_manager.fetch(spec.function_key)
+            args, kwargs = self._resolve_spec_args_sync(spec)
+            self._current_task_id = spec.task_id
+            result = fn(*args, **kwargs)
+            return {"results": self._pack_results(spec, result)}
+        except BaseException as e:  # noqa: BLE001
+            logger.info("task %s raised: %r", spec.function_name, e)
+            return {"results": [self._error_result(e)] * max(1, spec.num_returns)}
+        finally:
+            self._current_task_id = None
+
+    def _resolve_spec_args_sync(self, spec: TaskSpec) -> Tuple[list, dict]:
+        return self.loop_thread.run(self._resolve_spec_args(spec))
+
+    async def _resolve_spec_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        async def one(a):
+            if a[0] == "value":
+                return ser.deserialize(a[1])
+            ref = a[1]
+            obj = await self._resolve_ref(ref, None)
+            value, is_error = ser.deserialize_or_error(obj)
+            if is_error:
+                raise value
+            return value
+
+        args = [await one(a) for a in spec.args]
+        kwargs = {k: await one(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _pack_results(self, spec: TaskSpec, result: Any) -> List[Any]:
+        if spec.num_returns == 0:
+            return []
+        values = (result,) if spec.num_returns == 1 else tuple(result)
+        if spec.num_returns > 1 and len(values) != spec.num_returns:
+            raise ValueError(
+                f"task declared num_returns={spec.num_returns} but returned "
+                f"{len(values)} values")
+        cfg = get_config()
+        out = []
+        for i, v in enumerate(values):
+            obj = ser.serialize(v)
+            if obj.total_bytes() > cfg.max_inline_object_size:
+                oid = ObjectID.for_task_return(spec.task_id, i)
+                self.shm.put_serialized(oid, obj)
+                out.append(("shm", self.node_id.binary()))
+            else:
+                out.append(("inline", obj.metadata, obj.buffers))
+        return out
+
+    def _error_result(self, exc: BaseException) -> Tuple:
+        tb = traceback.format_exc()
+        err = RayTaskError(f"{type(exc).__name__}: {exc}", cause=exc,
+                           traceback_str=tb)
+        obj = ser.serialize_error(err)
+        return ("error", obj.buffers[0])
+
+    # ------------------------------------------------------------------
+    # Object-plane RPC handlers (owner side)
+    # ------------------------------------------------------------------
+    async def _rpc_get_object(
+        self, object_id: bytes, borrower: Optional[Tuple[str, int]] = None
+    ) -> Dict[str, Any]:
+        oid = ObjectID(object_id)
+        if borrower:
+            self.ref_counter.add_borrower(oid, tuple(borrower))
+        entry = self.memory_store.get_if_exists(oid)
+        if entry is None:
+            if self.shm.contains(oid):
+                return {"kind": "shm", "node_id": self.node_id.binary()}
+            if self.task_manager.get_spec(oid.task_id()) is not None:
+                return {"kind": "pending"}
+            return {"kind": "lost", "error": "unknown object"}
+        if isinstance(entry, ShmMarker):
+            return {"kind": "shm", "node_id": entry.node_id}
+        return {"kind": "inline", "metadata": entry.metadata,
+                "buffers": entry.buffers}
+
+    async def _rpc_wait_object(self, object_id: bytes,
+                               timeout: float = 30.0) -> bool:
+        oid = ObjectID(object_id)
+        try:
+            await self.memory_store.get(oid, timeout)
+            return True
+        except asyncio.TimeoutError:
+            return self.shm.contains(oid)
+
+    async def _rpc_add_borrows(self, borrower: Tuple[str, int],
+                               object_ids: List[bytes]) -> None:
+        for ob in object_ids:
+            self.ref_counter.add_borrower(ObjectID(ob), tuple(borrower))
+
+    async def _rpc_remove_borrows(self, borrower: Tuple[str, int],
+                                  object_ids: List[bytes]) -> None:
+        for ob in object_ids:
+            self.ref_counter.remove_borrower(ObjectID(ob), tuple(borrower))
+
+    async def _rpc_free_objects(self, object_ids: List[bytes]) -> None:
+        for ob in object_ids:
+            oid = ObjectID(ob)
+            self.memory_store.delete(oid)
+            try:
+                self.shm.delete(oid)
+            except Exception:
+                pass
+
+    async def _rpc_cancel_task(self, task_id: bytes) -> bool:
+        tid = TaskID(task_id)
+        self._cancelled_tasks.add(tid)
+        return True
+
+    async def _rpc_exit_worker(self) -> bool:
+        logger.info("exit_worker received; shutting down pid %d", os.getpid())
+        loop = asyncio.get_running_loop()
+        loop.call_later(0.05, os._exit, 0)
+        return True
+
+    async def _rpc_ping(self) -> str:
+        return "pong"
+
+    async def _cancel_pending(self, spec: TaskSpec) -> None:
+        """Best-effort cancel: tell the executor (if dispatched) and fail the
+        pending task locally (reference: CoreWorker::CancelTask)."""
+        import pickle as _p
+
+        pt_addr = None
+        with self.task_manager._lock:
+            pt = self.task_manager._pending.get(spec.task_id)
+            if pt is not None:
+                pt_addr = pt.inflight_on
+        if pt_addr is not None:
+            try:
+                client = RpcClient(*pt_addr, name="cancel")
+                await client.call("cancel_task", task_id=spec.task_id.binary(),
+                                  timeout=5)
+                await client.close()
+            except Exception:
+                pass
+        self.task_manager.fail_permanently(
+            spec.task_id,
+            ser.serialize_error(TaskCancelledError(spec.function_name)))
+
+    # ------------------------------------------------------------------
+    async def _borrow_report_loop(self) -> None:
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            reports = self.ref_counter.drain_borrow_reports()
+            for owner, oids in reports.items():
+                if owner == self.address:
+                    continue
+                try:
+                    client = RpcClient(*owner, name="borrow-report")
+                    await client.notify(
+                        "add_borrows", borrower=self.address,
+                        object_ids=[o.binary() for o in oids])
+                    await client.close()
+                except Exception:
+                    pass

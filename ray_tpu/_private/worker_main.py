@@ -1,0 +1,65 @@
+"""Worker process entry point (reference:
+python/ray/_private/workers/default_worker.py → run_task_loop)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.worker import Worker
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def main() -> None:
+    worker_id = WorkerID.from_hex(os.environ["RAY_TPU_WORKER_ID"])
+    nodelet_host, nodelet_port = os.environ["RAY_TPU_NODELET_ADDR"].rsplit(":", 1)
+    gcs_host, gcs_port = os.environ["RAY_TPU_GCS_ADDR"].rsplit(":", 1)
+    store_path = os.environ["RAY_TPU_STORE_PATH"]
+    session_dir = os.environ["RAY_TPU_SESSION_DIR"]
+    node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
+
+    worker = Worker(
+        mode="worker",
+        gcs_address=(gcs_host, int(gcs_port)),
+        nodelet_address=(nodelet_host, int(nodelet_port)),
+        store_path=store_path,
+        session_dir=session_dir,
+        node_id=node_id,
+        worker_id=worker_id,
+    )
+    worker.connect()
+    worker.loop_thread.run(
+        worker.nodelet_client.call(
+            "register_worker",
+            worker_id=worker_id.binary(),
+            address=worker.address,
+        )
+    )
+    logger.info("worker %s ready at %s", worker_id, worker.address)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    def _watch_parent() -> None:
+        # If the nodelet dies without reaping us we get reparented; exit
+        # rather than leak (reference: raylet kills workers on disconnect).
+        import time
+
+        ppid = os.getppid()
+        while not stop.is_set():
+            if os.getppid() != ppid:
+                logger.warning("nodelet gone; worker exiting")
+                os._exit(1)
+            time.sleep(1.0)
+
+    threading.Thread(target=_watch_parent, daemon=True).start()
+    stop.wait()
+    worker.disconnect()
+
+
+if __name__ == "__main__":
+    main()

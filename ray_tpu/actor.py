@@ -1,0 +1,122 @@
+"""Actor API: @ray_tpu.remote on classes → ActorClass / ActorHandle /
+ActorMethod (reference: python/ray/actor.py:1111,1784,579)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import ActorID
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        w = worker_mod.global_worker()
+        refs = w.submit_actor_task(
+            self._handle._actor_id,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries,
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} cannot be called directly; "
+            f"use .{self._method_name}.remote(...)"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_names: tuple,
+                 max_task_retries: int = 0):
+        object.__setattr__(self, "_actor_id", actor_id)
+        object.__setattr__(self, "_method_names", tuple(method_names))
+        object.__setattr__(self, "_max_task_retries", max_task_retries)
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._method_names and name not in self._method_names:
+            raise AttributeError(
+                f"actor has no method {name!r}; methods: {self._method_names}")
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id.hex()[:12]}…)"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id, self._method_names, self._max_task_retries),
+        )
+
+
+class ActorClass:
+    def __init__(self, cls, **default_options):
+        self._cls = cls
+        self._options = default_options
+        functools.update_wrapper(self, cls, updated=())
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated "
+            f"directly; use {self._cls.__name__}.remote(...)"
+        )
+
+    def options(self, **overrides) -> "ActorClass":
+        merged = {**self._options, **overrides}
+        return ActorClass(self._cls, **merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        w = worker_mod.global_worker()
+        opts = self._options
+        resources: Dict[str, float] = dict(opts.get("resources") or {})
+        num_cpus = opts.get("num_cpus")
+        num_tpus = opts.get("num_tpus")
+        # Reference semantics (python/ray/actor.py): an actor holds 0 CPUs for
+        # its lifetime unless num_cpus is explicit — otherwise a handful of
+        # actors would pin every CPU slot and starve task leases.
+        resources.setdefault("CPU", 0.0 if num_cpus is None else float(num_cpus))
+        if num_tpus:
+            resources["TPU"] = float(num_tpus)
+        lifetime = opts.get("lifetime")
+        actor_id = w.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            resources=resources,
+            name=opts.get("name") or "",
+            max_restarts=int(opts.get("max_restarts", 0)),
+            max_task_retries=int(opts.get("max_task_retries", 0)),
+            max_concurrency=int(opts.get("max_concurrency", 1)),
+            detached=(lifetime == "detached"),
+            runtime_env=opts.get("runtime_env"),
+            scheduling_strategy=opts.get("scheduling_strategy"),
+        )
+        return ActorHandle(
+            actor_id,
+            method_names=tuple(
+                m for m in dir(self._cls)
+                if not m.startswith("_") and callable(getattr(self._cls, m))
+            ),
+            max_task_retries=int(opts.get("max_task_retries", 0)),
+        )
+
+    @property
+    def underlying_class(self):
+        return self._cls

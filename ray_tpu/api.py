@@ -1,0 +1,216 @@
+"""Top-level public API (reference: python/ray/_private/worker.py
+init/get/put/wait/remote + actor/kill/cancel + get_actor).
+
+Cites: ray.init worker.py:1341, ray.get :2754, ray.put :2890, ray.wait :2955,
+ray.remote :3441, ray.kill :3100, ray.get_actor :2699.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import ActorID, JobID, NodeID
+from ray_tpu._private.node import Node
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu.actor import ActorClass, ActorHandle
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_global_node: Optional[Node] = None
+
+
+def is_initialized() -> bool:
+    return worker_mod.global_worker_or_none() is not None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    object_store_memory: Optional[int] = None,
+    ignore_reinit_error: bool = False,
+    namespace: Optional[str] = None,
+    runtime_env: Optional[Dict[str, Any]] = None,
+    log_to_driver: bool = True,
+    _node_name: str = "",
+) -> Dict[str, Any]:
+    """Start (or connect to) a cluster and connect this process as a driver."""
+    global _global_node
+    if is_initialized():
+        if ignore_reinit_error:
+            return {"address": None}
+        raise RuntimeError("ray_tpu.init() called twice "
+                           "(use ignore_reinit_error=True to allow)")
+
+    if address is None:
+        from ray_tpu._private.accelerators import detect_resources
+
+        total = detect_resources(num_cpus, num_tpus)
+        for k, v in (resources or {}).items():
+            total[k] = float(v)
+        _global_node = Node(
+            head=True,
+            resources=total,
+            object_store_memory=object_store_memory,
+            node_name=_node_name,
+        )
+        gcs_address = _global_node.gcs_address
+        nodelet_address = _global_node.nodelet_address
+        store_path = _global_node.store_path
+        node_id = NodeID(_global_node.node_id)
+        session_dir = _global_node.session_dir
+    else:
+        # "host:port" of an existing GCS; pick this host's nodelet.
+        host, _, port = address.partition(":")
+        gcs_address = (host, int(port))
+        from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+        boot = EventLoopThread("bootstrap")
+        client = RpcClient(*gcs_address)
+        try:
+            nodes = boot.run(client.call("list_nodes"))
+            boot.run(client.close())
+        finally:
+            boot.stop()
+        alive = [n for n in nodes if n["alive"]]
+        if not alive:
+            raise ConnectionError(f"no alive nodes registered at {address}")
+        chosen = alive[0]
+        nodelet_address = tuple(chosen["address"])
+        store_path = chosen["object_store_path"]
+        node_id = NodeID(chosen["node_id"])
+        session_dir = os.path.join("/tmp/ray_tpu", "client")
+
+    w = worker_mod.Worker(
+        mode="driver",
+        gcs_address=gcs_address,
+        nodelet_address=nodelet_address,
+        store_path=store_path,
+        session_dir=session_dir,
+        node_id=node_id,
+    )
+    w.connect()
+    job_id_int = w.loop_thread.run(
+        w.gcs_client.call("add_job", metadata={"namespace": namespace or "",
+                                               "pid": os.getpid()}))
+    w.job_id = JobID.from_int(job_id_int)
+    logger.info("ray_tpu initialized: gcs=%s job=%s", gcs_address, job_id_int)
+    return {
+        "address": f"{gcs_address[0]}:{gcs_address[1]}",
+        "session_dir": session_dir,
+        "job_id": job_id_int,
+    }
+
+
+def shutdown() -> None:
+    global _global_node
+    w = worker_mod.global_worker_or_none()
+    if w is not None:
+        try:
+            w.loop_thread.run(
+                w.gcs_client.call("finish_job", job_id=w.job_id.int()),
+                timeout=5)
+        except Exception:
+            pass
+        w.disconnect()
+    if _global_node is not None:
+        _global_node.shutdown()
+        _global_node = None
+
+
+def remote(*args, **options) -> Union[RemoteFunction, ActorClass]:
+    """@ray_tpu.remote / @ray_tpu.remote(num_cpus=..., num_tpus=...,
+    resources=..., num_returns=..., max_retries=..., max_restarts=...,
+    name=..., lifetime=..., max_concurrency=...)."""
+
+    def decorate(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, **options)
+        return RemoteFunction(obj, **options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes only keyword options")
+    return decorate
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        *, timeout: Optional[float] = None) -> Any:
+    w = worker_mod.global_worker()
+    if isinstance(refs, ObjectRef):
+        return w.get([refs], timeout)[0]
+    return w.get(list(refs), timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return worker_mod.global_worker().put(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError("ray_tpu.wait() expects a list of ObjectRefs")
+    return worker_mod.global_worker().wait(list(refs), num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    w = worker_mod.global_worker()
+    w.loop_thread.run(
+        w.gcs_client.call("kill_actor", actor_id=actor._actor_id.binary(),
+                          no_restart=no_restart))
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> None:
+    w = worker_mod.global_worker()
+    spec = w.task_manager.get_spec(ref.id.task_id())
+    if spec is None:
+        return
+    w.loop_thread.run(w._cancel_pending(spec))
+
+
+def get_actor(name: str) -> ActorHandle:
+    w = worker_mod.global_worker()
+    info = w.loop_thread.run(w.gcs_client.call("get_named_actor", name=name))
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r}")
+    return ActorHandle(ActorID.from_hex(info["actor_id"]), method_names=())
+
+
+def available_resources() -> Dict[str, float]:
+    w = worker_mod.global_worker()
+    nodes = w.loop_thread.run(w.gcs_client.call("list_nodes"))
+    out: Dict[str, float] = {}
+    for n in nodes:
+        if n["alive"]:
+            for k, v in n["resources_available"].items():
+                out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def cluster_resources() -> Dict[str, float]:
+    w = worker_mod.global_worker()
+    nodes = w.loop_thread.run(w.gcs_client.call("list_nodes"))
+    out: Dict[str, float] = {}
+    for n in nodes:
+        if n["alive"]:
+            for k, v in n["resources_total"].items():
+                out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def nodes() -> List[Dict[str, Any]]:
+    w = worker_mod.global_worker()
+    return w.loop_thread.run(w.gcs_client.call("list_nodes"))

@@ -1,0 +1,644 @@
+"""GCS — the cluster control plane.
+
+Counterpart of src/ray/gcs/gcs_server/ (C21–C23 in SURVEY.md §2.1): node
+manager, actor manager (FSM with restarts), job manager, internal KV, function
+store, placement groups, long-poll pub/sub, health checks, and the cluster
+resource view. One asyncio process; tables in memory (a persistence hook mirrors
+the reference's pluggable StoreClient so a Redis-style backend can slot in).
+
+Redesign notes: the reference runs ~11 gRPC services on one asio loop; here one
+RpcServer serves the union of handler methods. Actor scheduling leases workers
+from nodelets exactly like normal-task scheduling does (reference:
+gcs_actor_scheduler.h:115).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu._private.task_spec import ResourceSet
+from ray_tpu.utils.config import get_config
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Pub/sub: long-poll channels (reference: src/ray/pubsub/, O(#subscribers)
+# long-poll connections rather than O(#objects)).
+# ---------------------------------------------------------------------------
+class PubsubChannels:
+    def __init__(self):
+        self._messages: Dict[str, List[Tuple[int, Any]]] = {}
+        self._seq: Dict[str, int] = {}
+        self._cond = asyncio.Condition()
+        self.max_backlog = 10_000
+
+    async def publish(self, channel: str, message: Any) -> None:
+        async with self._cond:
+            seq = self._seq.get(channel, 0) + 1
+            self._seq[channel] = seq
+            backlog = self._messages.setdefault(channel, [])
+            backlog.append((seq, message))
+            if len(backlog) > self.max_backlog:
+                del backlog[: len(backlog) // 2]
+            self._cond.notify_all()
+
+    async def poll(
+        self, cursors: Dict[str, int], timeout: float = 30.0
+    ) -> Dict[str, List[Tuple[int, Any]]]:
+        """Return messages newer than each channel's cursor; blocks until
+        something arrives or timeout."""
+        deadline = time.monotonic() + timeout
+
+        def _collect() -> Dict[str, List[Tuple[int, Any]]]:
+            out: Dict[str, List[Tuple[int, Any]]] = {}
+            for channel, cursor in cursors.items():
+                msgs = [m for m in self._messages.get(channel, []) if m[0] > cursor]
+                if msgs:
+                    out[channel] = msgs
+            return out
+
+        async with self._cond:
+            while True:
+                out = _collect()
+                if out:
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                try:
+                    await asyncio.wait_for(self._cond.wait(), remaining)
+                except asyncio.TimeoutError:
+                    return {}
+
+
+# ---------------------------------------------------------------------------
+# Tables
+# ---------------------------------------------------------------------------
+class NodeInfo:
+    def __init__(self, node_id: NodeID, address: Tuple[str, int],
+                 resources: Dict[str, float], object_store_path: str,
+                 labels: Dict[str, str]):
+        self.node_id = node_id
+        self.address = address
+        self.resources_total = dict(resources)
+        self.resources_available = dict(resources)
+        self.object_store_path = object_store_path
+        self.labels = labels
+        self.alive = True
+        self.last_heartbeat = time.monotonic()
+
+
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"
+
+
+class ActorInfo:
+    def __init__(self, actor_id: ActorID, creation_spec: Any, name: str,
+                 max_restarts: int, detached: bool):
+        self.actor_id = actor_id
+        self.creation_spec = creation_spec  # pickled TaskSpec bytes
+        self.name = name
+        self.max_restarts = max_restarts
+        self.detached = detached
+        self.state = ACTOR_PENDING
+        self.address: Optional[Tuple[str, int]] = None
+        self.node_id: Optional[NodeID] = None
+        self.num_restarts = 0
+        self.death_cause: str = ""
+
+    def public_view(self) -> Dict[str, Any]:
+        return {
+            "actor_id": self.actor_id.hex(),
+            "state": self.state,
+            "name": self.name,
+            "address": self.address,
+            "node_id": self.node_id.hex() if self.node_id else None,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+        }
+
+
+class PlacementGroupInfo:
+    def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
+                 strategy: str, name: str):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.state = "PENDING"
+        # bundle index -> node_id
+        self.bundle_nodes: Dict[int, NodeID] = {}
+
+
+# ---------------------------------------------------------------------------
+# The server
+# ---------------------------------------------------------------------------
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = RpcServer(host, port)
+        self.pubsub = PubsubChannels()
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[str, ActorID] = {}
+        self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.jobs: Dict[int, Dict[str, Any]] = {}
+        self._job_counter = 0
+        self._nodelet_clients: Dict[NodeID, RpcClient] = {}
+        self._background: List[asyncio.Task] = []
+        self._actor_locks: Dict[ActorID, asyncio.Lock] = {}
+
+    async def start(self) -> Tuple[str, int]:
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                self.server.register(name[4:], getattr(self, name))
+        addr = await self.server.start()
+        self._background.append(asyncio.ensure_future(self._health_check_loop()))
+        logger.info("GCS listening on %s:%d", *addr)
+        return addr
+
+    async def stop(self) -> None:
+        for t in self._background:
+            t.cancel()
+        for c in self._nodelet_clients.values():
+            await c.close()
+        await self.server.stop()
+
+    def _nodelet(self, node_id: NodeID) -> RpcClient:
+        if node_id not in self._nodelet_clients:
+            info = self.nodes[node_id]
+            self._nodelet_clients[node_id] = RpcClient(*info.address, name="nodelet")
+        return self._nodelet_clients[node_id]
+
+    # ------------------------------------------------------------------
+    # Node management (reference: gcs_node_manager.h:49)
+    # ------------------------------------------------------------------
+    async def rpc_register_node(
+        self, node_id: bytes, address: Tuple[str, int],
+        resources: Dict[str, float], object_store_path: str,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, Any]:
+        nid = NodeID(node_id)
+        self.nodes[nid] = NodeInfo(nid, tuple(address), resources,
+                                   object_store_path, labels or {})
+        await self.pubsub.publish("nodes", {"event": "added", "node_id": node_id,
+                                            "address": address})
+        logger.info("node %s registered: %s", nid, resources)
+        return {"ok": True}
+
+    async def rpc_heartbeat(
+        self, node_id: bytes, resources_available: Dict[str, float],
+        load: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        nid = NodeID(node_id)
+        info = self.nodes.get(nid)
+        if info is None or not info.alive:
+            # Unknown OR previously declared dead (e.g. a transient stall
+            # exceeded the failure threshold): the node must re-register to
+            # rejoin scheduling — its actors were already failed over.
+            return {"ok": False, "reregister": True}
+        info.last_heartbeat = time.monotonic()
+        info.resources_available = resources_available
+        return {"ok": True}
+
+    async def rpc_list_nodes(self) -> List[Dict[str, Any]]:
+        return [
+            {
+                "node_id": n.node_id.binary(),
+                "address": n.address,
+                "alive": n.alive,
+                "resources_total": n.resources_total,
+                "resources_available": n.resources_available,
+                "object_store_path": n.object_store_path,
+                "labels": n.labels,
+            }
+            for n in self.nodes.values()
+        ]
+
+    async def rpc_drain_node(self, node_id: bytes) -> Dict[str, Any]:
+        nid = NodeID(node_id)
+        info = self.nodes.get(nid)
+        if info is None:
+            return {"ok": False}
+        await self._mark_node_dead(info, "drained")
+        return {"ok": True}
+
+    async def _health_check_loop(self) -> None:
+        cfg = get_config()
+        while True:
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+            deadline = cfg.heartbeat_interval_s * cfg.heartbeat_failure_threshold
+            now = time.monotonic()
+            for info in list(self.nodes.values()):
+                if info.alive and now - info.last_heartbeat > deadline:
+                    await self._mark_node_dead(info, "heartbeat timeout")
+
+    async def _mark_node_dead(self, info: NodeInfo, reason: str) -> None:
+        info.alive = False
+        logger.warning("node %s dead: %s", info.node_id, reason)
+        await self.pubsub.publish(
+            "nodes", {"event": "removed", "node_id": info.node_id.binary(),
+                      "reason": reason})
+        # Fail over actors that lived on that node.
+        for actor in list(self.actors.values()):
+            if actor.node_id == info.node_id and actor.state == ACTOR_ALIVE:
+                await self._on_actor_worker_death(actor, f"node died: {reason}")
+
+    # ------------------------------------------------------------------
+    # Internal KV + function store (reference: gcs_kv_manager.h,
+    # gcs_function_manager.h)
+    # ------------------------------------------------------------------
+    async def rpc_kv_put(self, key: str, value: bytes,
+                         overwrite: bool = True) -> bool:
+        if not overwrite and key in self.kv:
+            return False
+        self.kv[key] = value
+        return True
+
+    async def rpc_kv_get(self, key: str) -> Optional[bytes]:
+        return self.kv.get(key)
+
+    async def rpc_kv_del(self, key: str) -> bool:
+        return self.kv.pop(key, None) is not None
+
+    async def rpc_kv_keys(self, prefix: str = "") -> List[str]:
+        return [k for k in self.kv if k.startswith(prefix)]
+
+    # ------------------------------------------------------------------
+    # Jobs (reference: gcs_job_manager.h:52)
+    # ------------------------------------------------------------------
+    async def rpc_add_job(self, metadata: Dict[str, Any]) -> int:
+        self._job_counter += 1
+        self.jobs[self._job_counter] = {
+            "job_id": self._job_counter, "start_time": time.time(),
+            "state": "RUNNING", **metadata,
+        }
+        return self._job_counter
+
+    async def rpc_finish_job(self, job_id: int) -> None:
+        if job_id in self.jobs:
+            self.jobs[job_id]["state"] = "FINISHED"
+            self.jobs[job_id]["end_time"] = time.time()
+        # Non-detached actors of the job die with it.
+        for actor in list(self.actors.values()):
+            if (not actor.detached and actor.state != ACTOR_DEAD
+                    and actor.actor_id.job_id().int() == job_id):
+                await self._kill_actor(actor, "job finished", no_restart=True)
+
+    async def rpc_list_jobs(self) -> List[Dict[str, Any]]:
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------
+    # Cluster resource view / scheduling hints (reference:
+    # gcs_resource_manager.h + cluster_resource_scheduler)
+    # ------------------------------------------------------------------
+    def _alive_nodes(self) -> List[NodeInfo]:
+        return [n for n in self.nodes.values() if n.alive]
+
+    def _pick_node(self, resources: Dict[str, float],
+                   strategy: str = "hybrid",
+                   exclude: Optional[set] = None) -> Optional[NodeInfo]:
+        """Hybrid policy: prefer packing onto the most-utilized node that still
+        fits (reference: hybrid_scheduling_policy.h:50); spread = least
+        utilized first."""
+        req = ResourceSet(resources)
+        candidates = [
+            n for n in self._alive_nodes()
+            if (exclude is None or n.node_id not in exclude)
+            and req.fits_in(n.resources_available)
+        ]
+        if not candidates:
+            return None
+
+        def utilization(n: NodeInfo) -> float:
+            used = [
+                1 - n.resources_available.get(k, 0) / v
+                for k, v in n.resources_total.items() if v > 0
+            ]
+            return max(used) if used else 0.0
+
+        reverse = strategy != "spread"
+        return sorted(candidates, key=lambda n: (utilization(n), n.node_id.hex()),
+                      reverse=reverse)[0]
+
+    async def rpc_pick_node(
+        self, resources: Dict[str, float], strategy: str = "hybrid",
+        exclude: Optional[List[bytes]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        node = self._pick_node(
+            resources, strategy,
+            {NodeID(e) for e in exclude} if exclude else None)
+        if node is None:
+            return None
+        return {"node_id": node.node_id.binary(), "address": node.address,
+                "object_store_path": node.object_store_path}
+
+    # ------------------------------------------------------------------
+    # Actor management (reference: gcs_actor_manager.h:331 — the FSM)
+    # ------------------------------------------------------------------
+    def _actor_lock(self, actor_id: ActorID) -> asyncio.Lock:
+        return self._actor_locks.setdefault(actor_id, asyncio.Lock())
+
+    async def rpc_register_actor(
+        self, actor_id: bytes, creation_spec: bytes, name: str = "",
+        max_restarts: int = 0, detached: bool = False,
+    ) -> Dict[str, Any]:
+        aid = ActorID(actor_id)
+        if name:
+            if name in self.named_actors:
+                return {"ok": False,
+                        "error": f"actor name {name!r} already taken"}
+            self.named_actors[name] = aid
+        info = ActorInfo(aid, creation_spec, name, max_restarts, detached)
+        self.actors[aid] = info
+        asyncio.ensure_future(self._schedule_actor(info))
+        return {"ok": True}
+
+    async def _schedule_actor(self, info: ActorInfo) -> None:
+        async with self._actor_lock(info.actor_id):
+            await self._schedule_actor_locked(info)
+
+    async def _schedule_actor_locked(self, info: ActorInfo) -> None:
+        import pickle
+
+        spec = pickle.loads(info.creation_spec)
+        cfg = get_config()
+        backoff = cfg.retry_backoff_initial_s
+        deadline = time.monotonic() + cfg.worker_start_timeout_s
+        while info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+            node = self._pick_node(spec.resources)
+            if node is None:
+                if time.monotonic() > deadline:
+                    await self._actor_dead(
+                        info, "no node with required resources "
+                        f"{dict(spec.resources)}")
+                    return
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, cfg.retry_backoff_max_s)
+                continue
+            try:
+                lease = await self._nodelet(node.node_id).call(
+                    "lease_worker",
+                    resources=dict(spec.resources),
+                    runtime_env=spec.runtime_env,
+                    lifetime="actor",
+                    timeout=cfg.worker_start_timeout_s,
+                )
+                if not lease.get("ok"):
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, cfg.retry_backoff_max_s)
+                    continue
+                worker_addr = tuple(lease["worker_address"])
+                worker_client = RpcClient(*worker_addr, name="actor-worker")
+                result = await worker_client.call(
+                    "create_actor", creation_spec=info.creation_spec,
+                    timeout=cfg.worker_start_timeout_s)
+                await worker_client.close()
+                if not result.get("ok"):
+                    await self._actor_dead(
+                        info, f"creation failed: {result.get('error')}")
+                    return
+                info.state = ACTOR_ALIVE
+                info.address = worker_addr
+                info.node_id = node.node_id
+                await self.pubsub.publish(
+                    "actors", {"event": "alive",
+                               "actor": info.public_view()})
+                logger.info("actor %s alive at %s", info.actor_id, worker_addr)
+                return
+            except Exception as e:
+                logger.warning("actor %s scheduling attempt failed: %r",
+                               info.actor_id, e)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, cfg.retry_backoff_max_s)
+                if time.monotonic() > deadline:
+                    await self._actor_dead(info, f"scheduling failed: {e!r}")
+                    return
+
+    async def _actor_dead(self, info: ActorInfo, cause: str) -> None:
+        info.state = ACTOR_DEAD
+        info.death_cause = cause
+        info.address = None
+        if info.name:
+            self.named_actors.pop(info.name, None)
+        await self.pubsub.publish(
+            "actors", {"event": "dead", "actor": info.public_view()})
+        logger.info("actor %s dead: %s", info.actor_id, cause)
+
+    async def _on_actor_worker_death(self, info: ActorInfo, cause: str) -> None:
+        """FSM transition on worker failure (reference:
+        gcs_actor_manager.cc:1318 RestartActor)."""
+        async with self._actor_lock(info.actor_id):
+            if info.state == ACTOR_DEAD:
+                return
+            if info.max_restarts == -1 or info.num_restarts < info.max_restarts:
+                info.num_restarts += 1
+                info.state = ACTOR_RESTARTING
+                info.address = None
+                await self.pubsub.publish(
+                    "actors", {"event": "restarting",
+                               "actor": info.public_view()})
+                logger.info("restarting actor %s (%d)", info.actor_id,
+                            info.num_restarts)
+                await self._schedule_actor_locked(info)
+            else:
+                await self._actor_dead(info, cause)
+
+    async def rpc_report_worker_death(
+        self, node_id: bytes, worker_address: Tuple[str, int], reason: str,
+        actor_ids: Optional[List[bytes]] = None,
+    ) -> None:
+        addr = tuple(worker_address)
+        for info in list(self.actors.values()):
+            if info.state == ACTOR_ALIVE and info.address == addr:
+                asyncio.ensure_future(
+                    self._on_actor_worker_death(info, f"worker died: {reason}"))
+
+    async def rpc_get_actor(self, actor_id: bytes) -> Optional[Dict[str, Any]]:
+        info = self.actors.get(ActorID(actor_id))
+        return info.public_view() if info else None
+
+    async def rpc_get_named_actor(self, name: str) -> Optional[Dict[str, Any]]:
+        aid = self.named_actors.get(name)
+        if aid is None:
+            return None
+        return self.actors[aid].public_view()
+
+    async def rpc_list_actors(self) -> List[Dict[str, Any]]:
+        return [a.public_view() for a in self.actors.values()]
+
+    async def rpc_kill_actor(self, actor_id: bytes,
+                             no_restart: bool = True) -> Dict[str, Any]:
+        info = self.actors.get(ActorID(actor_id))
+        if info is None:
+            return {"ok": False, "error": "no such actor"}
+        await self._kill_actor(info, "ray_tpu.kill", no_restart=no_restart)
+        return {"ok": True}
+
+    async def _kill_actor(self, info: ActorInfo, cause: str,
+                          no_restart: bool) -> None:
+        addr = info.address
+        if no_restart:
+            await self._actor_dead(info, cause)
+        if addr is not None:
+            try:
+                client = RpcClient(*addr, name="kill")
+                await client.call("exit_worker", timeout=5)
+                await client.close()
+            except Exception:
+                pass  # worker may already be gone; nodelet reaps it
+
+    # ------------------------------------------------------------------
+    # Placement groups (reference: gcs_placement_group_mgr.h:232; 2-phase
+    # prepare/commit via nodelets, bundle policies C15/C17)
+    # ------------------------------------------------------------------
+    async def rpc_create_placement_group(
+        self, pg_id: bytes, bundles: List[Dict[str, float]], strategy: str,
+        name: str = "",
+    ) -> Dict[str, Any]:
+        pgid = PlacementGroupID(pg_id)
+        info = PlacementGroupInfo(pgid, bundles, strategy, name)
+        self.placement_groups[pgid] = info
+        ok = await self._schedule_pg(info)
+        if ok:
+            info.state = "CREATED"
+            await self.pubsub.publish("placement_groups",
+                                      {"event": "created", "pg_id": pg_id})
+            return {"ok": True,
+                    "bundle_nodes": {i: nid.binary()
+                                     for i, nid in info.bundle_nodes.items()}}
+        info.state = "INFEASIBLE"
+        return {"ok": False, "error": "infeasible placement group"}
+
+    async def _schedule_pg(self, info: PlacementGroupInfo) -> bool:
+        # Choose nodes per bundle under the strategy.
+        sim_avail = {
+            n.node_id: dict(n.resources_available) for n in self._alive_nodes()
+        }
+        assignment: Dict[int, NodeID] = {}
+        used_nodes: set = set()
+        for i, bundle in enumerate(info.bundles):
+            req = ResourceSet(bundle)
+            candidates = [
+                nid for nid, avail in sim_avail.items() if req.fits_in(avail)
+            ]
+            if info.strategy in ("STRICT_PACK", "PACK") and assignment:
+                pref = [nid for nid in candidates if nid in used_nodes]
+                if pref:
+                    candidates = pref
+                elif info.strategy == "STRICT_PACK":
+                    return False
+            if info.strategy == "STRICT_SPREAD":
+                candidates = [nid for nid in candidates if nid not in used_nodes]
+            elif info.strategy == "SPREAD":
+                fresh = [nid for nid in candidates if nid not in used_nodes]
+                if fresh:
+                    candidates = fresh
+            if not candidates:
+                return False
+            nid = candidates[0]
+            req.subtract_from(sim_avail[nid])
+            assignment[i] = nid
+            used_nodes.add(nid)
+        # 2-phase: prepare all, then commit (reference:
+        # placement_group_resource_manager.h:50).
+        prepared: List[Tuple[NodeID, int]] = []
+        try:
+            for i, nid in assignment.items():
+                r = await self._nodelet(nid).call(
+                    "prepare_bundle", pg_id=info.pg_id.binary(),
+                    bundle_index=i, resources=info.bundles[i])
+                if not r.get("ok"):
+                    raise RuntimeError("prepare failed")
+                prepared.append((nid, i))
+            for i, nid in assignment.items():
+                await self._nodelet(nid).call(
+                    "commit_bundle", pg_id=info.pg_id.binary(), bundle_index=i)
+        except Exception as e:
+            logger.warning("pg %s scheduling failed: %r", info.pg_id, e)
+            for nid, i in prepared:
+                try:
+                    await self._nodelet(nid).call(
+                        "return_bundle", pg_id=info.pg_id.binary(),
+                        bundle_index=i)
+                except Exception:
+                    pass
+            return False
+        info.bundle_nodes = assignment
+        return True
+
+    async def rpc_remove_placement_group(self, pg_id: bytes) -> Dict[str, Any]:
+        pgid = PlacementGroupID(pg_id)
+        info = self.placement_groups.pop(pgid, None)
+        if info is None:
+            return {"ok": False}
+        for i, nid in info.bundle_nodes.items():
+            try:
+                await self._nodelet(nid).call(
+                    "return_bundle", pg_id=pg_id, bundle_index=i)
+            except Exception:
+                pass
+        return {"ok": True}
+
+    async def rpc_get_placement_group(self, pg_id: bytes) -> Optional[Dict[str, Any]]:
+        info = self.placement_groups.get(PlacementGroupID(pg_id))
+        if info is None:
+            return None
+        return {"pg_id": pg_id, "state": info.state, "strategy": info.strategy,
+                "bundles": info.bundles,
+                "bundle_nodes": {i: n.binary()
+                                 for i, n in info.bundle_nodes.items()}}
+
+    async def rpc_list_placement_groups(self) -> List[Dict[str, Any]]:
+        return [
+            {"pg_id": p.pg_id.binary(), "state": p.state, "name": p.name,
+             "strategy": p.strategy, "bundles": p.bundles}
+            for p in self.placement_groups.values()
+        ]
+
+    # ------------------------------------------------------------------
+    # Pub/sub RPC surface
+    # ------------------------------------------------------------------
+    async def rpc_pubsub_poll(
+        self, cursors: Dict[str, int], timeout: float = 30.0
+    ) -> Dict[str, List[Tuple[int, Any]]]:
+        return await self.pubsub.poll(cursors, timeout)
+
+    async def rpc_publish(self, channel: str, message: Any) -> None:
+        await self.pubsub.publish(channel, message)
+
+    async def rpc_ping(self) -> str:
+        return "pong"
+
+
+async def run_gcs_server(host: str, port: int) -> GcsServer:
+    gcs = GcsServer(host, port)
+    await gcs.start()
+    return gcs
+
+
+def main() -> None:  # pragma: no cover - exercised via subprocess
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True)
+    args = parser.parse_args()
+
+    async def _run():
+        await run_gcs_server(args.host, args.port)
+        await asyncio.Event().wait()
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

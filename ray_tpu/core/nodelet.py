@@ -1,0 +1,460 @@
+"""Nodelet — the per-node manager (raylet equivalent, SURVEY §2.1 C13–C20).
+
+Owns: the node's shared-memory object store file, the worker pool (spawning /
+reaping worker processes), local resource accounting + the lease protocol,
+placement-group bundle prepare/commit, and heartbeats to GCS.
+
+Redesign vs the reference raylet: no separate plasma server process (the store
+is the mapped arena from shm_store.cc); leases are granted over the same RPC
+plane; worker pushes happen directly submitter→worker so the nodelet stays off
+the task hot path entirely (the reference also bypasses the raylet for actor
+calls, but normal tasks flow through its dispatch queue — here a lease is a
+worker address and the submitter talks to the worker directly, which is why
+task throughput scales with submitters, not with the nodelet).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.ids import NodeID, WorkerID
+from ray_tpu._private.rpc import RpcClient, RpcServer
+from ray_tpu._private.task_spec import ResourceSet
+from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.utils.config import get_config
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class WorkerHandle:
+    def __init__(self, worker_id: WorkerID, proc: subprocess.Popen,
+                 env_key: str):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.env_key = env_key
+        self.address: Optional[Tuple[str, int]] = None
+        self.ready = asyncio.Event()
+        self.leased = False
+        self.lifetime = "task"  # or "actor"
+        self.resources: Optional[ResourceSet] = None
+        self.pg_bundle: Optional[Tuple[bytes, int]] = None
+        self.last_idle = time.monotonic()
+
+
+class Nodelet:
+    def __init__(
+        self,
+        gcs_address: Tuple[str, int],
+        session_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        resources: Optional[Dict[str, float]] = None,
+        object_store_memory: Optional[int] = None,
+        node_name: str = "",
+    ):
+        self.node_id = NodeID.from_random()
+        self.gcs_address = gcs_address
+        self.session_dir = session_dir
+        self.server = RpcServer(host, port)
+        self.node_name = node_name or self.node_id.hex()[:8]
+
+        from ray_tpu._private.accelerators import detect_resources
+
+        self.resources_total = dict(resources or detect_resources())
+        self.resources_available = dict(self.resources_total)
+        cfg = get_config()
+        store_capacity = object_store_memory or cfg.object_store_memory
+        os.makedirs(session_dir, exist_ok=True)
+        self.store_path = os.path.join(
+            "/dev/shm", f"ray_tpu_{os.path.basename(session_dir)}_{self.node_name}"
+        )
+        if os.path.exists(self.store_path):
+            os.unlink(self.store_path)
+        self.store = SharedMemoryStore(self.store_path, capacity=store_capacity,
+                                       create=True)
+        self.workers: Dict[WorkerID, WorkerHandle] = {}
+        self._gcs: Optional[RpcClient] = None
+        self._background: List[asyncio.Task] = []
+        self._lease_waiters: List[asyncio.Event] = []
+        # pg bundles: (pg_id, bundle_index) -> {"resources": .., "state": ..}
+        self._bundles: Dict[Tuple[bytes, int], Dict[str, Any]] = {}
+        self._shutting_down = False
+
+    # ------------------------------------------------------------------
+    async def start(self) -> Tuple[str, int]:
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                self.server.register(name[4:], getattr(self, name))
+        addr = await self.server.start()
+        self._gcs = RpcClient(*self.gcs_address, name="gcs")
+        await self._gcs.call_retrying(
+            "register_node",
+            node_id=self.node_id.binary(),
+            address=addr,
+            resources=self.resources_total,
+            object_store_path=self.store_path,
+            labels={"node_name": self.node_name},
+        )
+        self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._background.append(asyncio.ensure_future(self._reap_loop()))
+        logger.info("nodelet %s on %s:%d resources=%s", self.node_name, *addr,
+                    self.resources_total)
+        return addr
+
+    async def stop(self) -> None:
+        self._shutting_down = True
+        for t in self._background:
+            t.cancel()
+        for w in list(self.workers.values()):
+            if w.proc.poll() is None:
+                w.proc.terminate()
+        await asyncio.sleep(0)
+        for w in list(self.workers.values()):
+            try:
+                w.proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()
+        if self._gcs:
+            await self._gcs.close()
+        await self.server.stop()
+        self.store.close()
+        if os.path.exists(self.store_path):
+            os.unlink(self.store_path)
+
+    # ------------------------------------------------------------------
+    # Worker pool (reference: worker_pool.h:283)
+    # ------------------------------------------------------------------
+    def _spawn_worker(self, env_key: str,
+                      runtime_env: Optional[Dict[str, Any]]) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_NODELET_ADDR"] = f"{self.server.host}:{self.server.port}"
+        env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_address[0]}:{self.gcs_address[1]}"
+        env["RAY_TPU_STORE_PATH"] = self.store_path
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        if runtime_env:
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                env[k] = v
+        log_dir = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:8]}.log"), "wb")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            env=env, stdout=out, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        handle = WorkerHandle(worker_id, proc, env_key)
+        self.workers[worker_id] = handle
+        return handle
+
+    async def rpc_register_worker(
+        self, worker_id: bytes, address: Tuple[str, int]
+    ) -> Dict[str, Any]:
+        """Called by a freshly-started worker process."""
+        wid = WorkerID(worker_id)
+        handle = self.workers.get(wid)
+        if handle is None:
+            return {"ok": False}
+        handle.address = tuple(address)
+        handle.ready.set()
+        return {"ok": True}
+
+    async def _get_idle_worker(
+        self, env_key: str, runtime_env: Optional[Dict[str, Any]]
+    ) -> WorkerHandle:
+        """Returns a worker already marked leased — reserving at selection
+        time closes the race where two lease requests pick the same worker
+        (one scanning the pool while the other awaits its spawned worker's
+        ready event)."""
+        for w in self.workers.values():
+            if (not w.leased and w.env_key == env_key and w.ready.is_set()
+                    and w.proc.poll() is None):
+                w.leased = True
+                return w
+        handle = self._spawn_worker(env_key, runtime_env)
+        handle.leased = True
+        try:
+            await asyncio.wait_for(handle.ready.wait(),
+                                   get_config().worker_start_timeout_s)
+        except BaseException:
+            handle.leased = False
+            if handle.proc.poll() is None:
+                handle.proc.terminate()
+            self.workers.pop(handle.worker_id, None)
+            raise
+        return handle
+
+    # ------------------------------------------------------------------
+    # Leases (reference: RequestWorkerLease node_manager.proto:394 +
+    # LocalTaskManager dispatch)
+    # ------------------------------------------------------------------
+    async def rpc_lease_worker(
+        self,
+        resources: Dict[str, float],
+        runtime_env: Optional[Dict[str, Any]] = None,
+        lifetime: str = "task",
+        pg_bundle: Optional[Tuple[bytes, int]] = None,
+        block: bool = True,
+    ) -> Dict[str, Any]:
+        req = ResourceSet(resources)
+        env_key = repr(sorted((runtime_env or {}).items()))
+        cfg = get_config()
+        deadline = time.monotonic() + cfg.worker_start_timeout_s
+        while True:
+            pool = self._bundle_pool(pg_bundle)
+            if pool is None:
+                return {"ok": False, "error": "unknown placement bundle"}
+            if req.fits_in(pool):
+                req.subtract_from(pool)
+                try:
+                    worker = await self._get_idle_worker(env_key, runtime_env)
+                except Exception as e:
+                    req.add_to(pool)
+                    return {"ok": False, "error": f"worker start failed: {e!r}"}
+                worker.leased = True
+                worker.lifetime = lifetime
+                worker.resources = req
+                worker.pg_bundle = pg_bundle
+                return {
+                    "ok": True,
+                    "worker_id": worker.worker_id.binary(),
+                    "worker_address": worker.address,
+                    "node_id": self.node_id.binary(),
+                }
+            if not block:
+                return {"ok": False, "error": "resources unavailable",
+                        "retry": True}
+            if time.monotonic() > deadline:
+                return {"ok": False, "error": "lease timeout", "retry": True}
+            event = asyncio.Event()
+            self._lease_waiters.append(event)
+            try:
+                await asyncio.wait_for(event.wait(), 1.0)
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                if event in self._lease_waiters:
+                    self._lease_waiters.remove(event)
+
+    def _bundle_pool(self, pg_bundle) -> Optional[Dict[str, float]]:
+        if pg_bundle is None:
+            return self.resources_available
+        entry = self._bundles.get((bytes(pg_bundle[0]), int(pg_bundle[1])))
+        if entry is None or entry["state"] != "committed":
+            return None
+        return entry["available"]
+
+    async def rpc_return_worker(
+        self, worker_id: bytes, kill: bool = False
+    ) -> Dict[str, Any]:
+        wid = WorkerID(worker_id)
+        worker = self.workers.get(wid)
+        if worker is None:
+            return {"ok": False}
+        if worker.resources is not None:
+            pool = self._bundle_pool(getattr(worker, "pg_bundle", None))
+            if pool is not None:
+                worker.resources.add_to(pool)
+            worker.resources = None
+        worker.leased = False
+        worker.last_idle = time.monotonic()
+        self._wake_lease_waiters()
+        if kill and worker.proc.poll() is None:
+            worker.proc.terminate()
+        return {"ok": True}
+
+    def _wake_lease_waiters(self) -> None:
+        for event in self._lease_waiters:
+            event.set()
+
+    # ------------------------------------------------------------------
+    # Placement group bundles: 2-phase prepare/commit (reference:
+    # placement_group_resource_manager.h:50,90)
+    # ------------------------------------------------------------------
+    async def rpc_prepare_bundle(
+        self, pg_id: bytes, bundle_index: int, resources: Dict[str, float]
+    ) -> Dict[str, Any]:
+        req = ResourceSet(resources)
+        if not req.fits_in(self.resources_available):
+            return {"ok": False, "error": "insufficient resources"}
+        req.subtract_from(self.resources_available)
+        self._bundles[(pg_id, bundle_index)] = {
+            "resources": dict(req), "available": dict(req), "state": "prepared",
+        }
+        return {"ok": True}
+
+    async def rpc_commit_bundle(self, pg_id: bytes,
+                                bundle_index: int) -> Dict[str, Any]:
+        entry = self._bundles.get((pg_id, bundle_index))
+        if entry is None:
+            return {"ok": False}
+        entry["state"] = "committed"
+        self._wake_lease_waiters()
+        return {"ok": True}
+
+    async def rpc_return_bundle(self, pg_id: bytes,
+                                bundle_index: int) -> Dict[str, Any]:
+        entry = self._bundles.pop((pg_id, bundle_index), None)
+        if entry is not None:
+            ResourceSet(entry["resources"]).add_to(self.resources_available)
+            self._wake_lease_waiters()
+        return {"ok": True}
+
+    # ------------------------------------------------------------------
+    # Introspection / state API support
+    # ------------------------------------------------------------------
+    async def rpc_node_stats(self) -> Dict[str, Any]:
+        return {
+            "node_id": self.node_id.binary(),
+            "node_name": self.node_name,
+            "resources_total": self.resources_total,
+            "resources_available": dict(self.resources_available),
+            "num_workers": len(self.workers),
+            "num_leased": sum(1 for w in self.workers.values() if w.leased),
+            "store": self.store.stats(),
+            "store_path": self.store_path,
+            "bundles": {
+                f"{k[0].hex()[:8]}:{k[1]}": v["state"]
+                for k, v in self._bundles.items()
+            },
+        }
+
+    async def rpc_fetch_object(self, object_id: bytes) -> Optional[Dict[str, Any]]:
+        """Serve a sealed object from this node's store to a remote puller
+        (reference: ObjectManager Push/Pull, object_manager.proto:60 — here a
+        single framed reply; the rpc layer ships buffers out-of-band)."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid = ObjectID(object_id)
+        obj = self.store.get_serialized(oid)
+        if obj is None:
+            return None
+        try:
+            return {
+                "metadata": bytes(obj.metadata),
+                "buffers": [bytes(b) for b in obj.buffers],
+            }
+        finally:
+            self.store.release(oid)
+
+    async def rpc_ping(self) -> str:
+        return "pong"
+
+    # ------------------------------------------------------------------
+    # Background loops
+    # ------------------------------------------------------------------
+    async def _heartbeat_loop(self) -> None:
+        cfg = get_config()
+        while not self._shutting_down:
+            try:
+                reply = await self._gcs.call(
+                    "heartbeat",
+                    node_id=self.node_id.binary(),
+                    resources_available=dict(self.resources_available),
+                )
+                if not reply.get("ok") and reply.get("reregister"):
+                    # GCS declared us dead (transient stall past the failure
+                    # threshold) or restarted without our record: rejoin.
+                    logger.warning("GCS lost this node; re-registering")
+                    await self._gcs.call(
+                        "register_node",
+                        node_id=self.node_id.binary(),
+                        address=(self.server.host, self.server.port),
+                        resources=self.resources_total,
+                        object_store_path=self.store_path,
+                        labels={"node_name": self.node_name},
+                    )
+            except Exception as e:
+                logger.warning("heartbeat failed: %r", e)
+            await asyncio.sleep(cfg.heartbeat_interval_s)
+
+    async def _reap_loop(self) -> None:
+        """Detect dead workers; release their resources; tell GCS (reference:
+        NodeManager worker-failure handling + plasma client disconnect)."""
+        cfg = get_config()
+        idle_ttl = 60.0
+        while not self._shutting_down:
+            await asyncio.sleep(0.2)
+            for wid, w in list(self.workers.items()):
+                code = w.proc.poll()
+                if code is not None:
+                    del self.workers[wid]
+                    if w.resources is not None:
+                        pool = self._bundle_pool(getattr(w, "pg_bundle", None))
+                        if pool is not None:
+                            w.resources.add_to(pool)
+                    self._wake_lease_waiters()
+                    if w.leased:
+                        try:
+                            await self._gcs.call(
+                                "report_worker_death",
+                                node_id=self.node_id.binary(),
+                                worker_address=w.address,
+                                reason=f"exit code {code}",
+                            )
+                        except Exception:
+                            pass
+                elif (not w.leased and w.ready.is_set()
+                      and time.monotonic() - w.last_idle > idle_ttl):
+                    # Trim warm pool beyond the configured size.
+                    idle = [x for x in self.workers.values()
+                            if not x.leased and x.env_key == w.env_key]
+                    if len(idle) > cfg.idle_worker_pool_size:
+                        w.proc.terminate()
+            self.store.reclaim_stale(120)
+
+
+def main() -> None:  # pragma: no cover - exercised via subprocess
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--resources", default="")
+    parser.add_argument("--object-store-memory", type=int, default=0)
+    parser.add_argument("--node-name", default="")
+    args = parser.parse_args()
+
+    resources = json.loads(args.resources) if args.resources else None
+
+    async def _run():
+        import signal
+
+        nodelet = Nodelet(
+            (args.gcs_host, args.gcs_port),
+            args.session_dir,
+            host=args.host,
+            port=args.port,
+            resources=resources,
+            object_store_memory=args.object_store_memory or None,
+            node_name=args.node_name,
+        )
+        await nodelet.start()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        # Reap workers before exiting — otherwise they leak past the session.
+        await nodelet.stop()
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":
+    main()

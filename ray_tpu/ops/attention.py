@@ -1,0 +1,229 @@
+"""Attention ops: numerically-stable blockwise (flash) attention.
+
+Net-new TPU kernel work (the reference free-rides on vLLM's CUDA kernels —
+SURVEY §7.3): a Pallas TPU flash-attention kernel for the hot path plus a pure
+jnp blockwise reference used on CPU meshes, in tests, and as the per-step
+primitive of ring attention (ray_tpu/parallel/ring.py).
+
+Shapes follow jax convention: q [B, Sq, H, D], k/v [B, Skv, Hkv, D] with GQA
+(H a multiple of Hkv).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _gqa_expand(k: jax.Array, v: jax.Array, num_heads: int) -> Tuple[jax.Array, jax.Array]:
+    num_kv = k.shape[2]
+    if num_kv == num_heads:
+        return k, v
+    rep = num_heads // num_kv
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset: int = 0,
+) -> jax.Array:
+    """Plain softmax attention (test oracle)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    k, v = _gqa_expand(k, v, q.shape[2])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        precision=jax.lax.Precision.HIGHEST) * scale
+    if causal:
+        q_ids = jnp.arange(q.shape[1])[:, None] + q_offset
+        k_ids = jnp.arange(k.shape[1])[None, :]
+        logits = jnp.where(k_ids <= q_ids, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32),
+                      precision=jax.lax.Precision.HIGHEST).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Blockwise primitive: one (q_block × kv_block) flash update. Shared by ring
+# attention; operates on [B, S, H, D] blocks with running stats.
+# ---------------------------------------------------------------------------
+def block_attn_update(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, H, D] (already GQA-expanded)
+    v: jax.Array,
+    m: jax.Array,  # [B, H, Sq] running rowmax
+    l: jax.Array,  # [B, H, Sq] running denominator
+    o: jax.Array,  # [B, Sq, H, D] running numerator (unnormalized)
+    *,
+    scale: float,
+    mask: Optional[jax.Array] = None,  # [Sq, Sk] additive (0 / NEG_INF)
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   precision=jax.lax.Precision.HIGHEST) * scale
+    if mask is not None:
+        s = s + mask[None, None, :, :]
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32),
+                    precision=jax.lax.Precision.HIGHEST)
+    o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def block_attn_init(q: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    b, sq, h, d = q.shape
+    m = jnp.full((b, h, sq), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((b, h, sq), dtype=jnp.float32)
+    o = jnp.zeros((b, sq, h, d), dtype=jnp.float32)
+    return m, l, o
+
+
+def block_attn_finish(l: jax.Array, o: jax.Array, dtype) -> jax.Array:
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU flash attention kernel
+# ---------------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  num_k_blocks: int):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32)        # [block_q, d]
+        k = k_ref[0, 0].astype(jnp.float32)        # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST) * scale  # [bq, bk]
+        if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_ids <= q_ids, s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_scr[:, 0] = l_scr[:, 0] * alpha + p.sum(axis=-1)
+        m_scr[:, 0] = m_new
+        v = v_ref[0, 0].astype(jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST)
+
+    if causal:
+        # Skip fully-masked kv blocks (upper triangle).
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        denom = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas flash attention. q [B,Sq,H,D], k/v [B,Skv,Hkv,D] → [B,Sq,H,D].
+
+    Grid (B, H, q_blocks, k_blocks); k dimension is sequential ("arbitrary")
+    carrying running softmax stats in VMEM scratch.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    b, sq, h, d = q.shape
+    skv = k.shape[1]
+    k, v = _gqa_expand(k, v, h)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, skv)
+    if sq % block_q or skv % block_k:
+        raise ValueError(f"seq lens ({sq},{skv}) must divide blocks "
+                         f"({block_q},{block_k})")
+    num_k_blocks = skv // block_k
+    # Layout [B, H, S, D] for clean 2D blocks.
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (b, h, sq // block_q, num_k_blocks)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=num_k_blocks)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[
+            _vmem((block_q, 1)),
+            _vmem((block_q, 1)),
+            _vmem((block_q, d)),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _vmem(shape):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, jnp.float32)
+
+
+def _compiler_params():
+    from jax.experimental.pallas import tpu as pltpu
+
+    try:
+        return pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+    except Exception:
+        return None

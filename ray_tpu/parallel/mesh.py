@@ -1,0 +1,91 @@
+"""Device mesh construction — the substrate for every parallelism strategy.
+
+This is net-new TPU-first design (the reference delegates model sharding to
+torch/NCCL per SURVEY §2.7): a single `Mesh` with canonical axis names is the
+coordinate system for DP/FSDP/TP/SP/PP/EP, and XLA inserts the collectives.
+
+Canonical axes (order matters — outer axes map to DCN/slower links, inner to
+ICI):
+    "data"    — pure data parallelism (gradients psum'd)
+    "fsdp"    — ZeRO-style parameter/optimizer sharding (weights all-gathered)
+    "stage"   — pipeline stages
+    "tensor"  — tensor parallelism (megatron-style)
+    "seq"     — sequence/context parallelism (ring attention)
+    "expert"  — MoE expert parallelism
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS_ORDER = ("data", "fsdp", "stage", "expert", "seq", "tensor")
+
+
+def create_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    allow_split_physical_axes: bool = True,
+) -> Mesh:
+    """Build a Mesh from an axis-size dict, e.g. {"data": 2, "tensor": 4}.
+
+    Unspecified axes get size 1; a single -1 axis absorbs remaining devices.
+    Uses jax.experimental.mesh_utils when available so the mesh layout follows
+    the physical ICI topology (critical: keeps "tensor"/"seq" neighbors on
+    direct ICI links).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    shape = dict(shape or {})
+    for ax in list(shape):
+        if ax not in AXIS_ORDER:
+            raise ValueError(f"unknown mesh axis {ax!r}; use {AXIS_ORDER}")
+    sizes = {ax: shape.get(ax, 1) for ax in AXIS_ORDER}
+    wildcard = [ax for ax, v in sizes.items() if v == -1]
+    if len(wildcard) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = math.prod(v for v in sizes.values() if v != -1)
+    if wildcard:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by {fixed}")
+        sizes[wildcard[0]] = n // fixed
+    elif fixed != n:
+        raise ValueError(
+            f"mesh shape {sizes} needs {fixed} devices but {n} are available")
+    axis_names = tuple(AXIS_ORDER)
+    dims = tuple(sizes[ax] for ax in axis_names)
+    try:
+        from jax.experimental import mesh_utils
+
+        device_array = mesh_utils.create_device_mesh(
+            dims, devices=devices,
+            allow_split_physical_axes=allow_split_physical_axes)
+    except Exception:
+        device_array = np.array(devices).reshape(dims)
+    return Mesh(device_array, axis_names)
+
+
+def single_device_mesh() -> Mesh:
+    return create_mesh({})
+
+
+def mesh_shape(mesh: Mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def dp_axes(mesh: Mesh) -> List[str]:
+    """Axes over which gradients are summed (data + fsdp)."""
+    return [ax for ax in ("data", "fsdp") if mesh_shape(mesh).get(ax, 1) >= 1]
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

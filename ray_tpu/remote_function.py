@@ -1,0 +1,55 @@
+"""@ray_tpu.remote for functions (reference: python/ray/remote_function.py:41)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+class RemoteFunction:
+    def __init__(self, fn, **default_options):
+        self._fn = fn
+        self._options = default_options
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._fn.__name__!r} cannot be called directly; "
+            f"use {self._fn.__name__}.remote(...)"
+        )
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = {**self._options, **overrides}
+        return RemoteFunction(self._fn, **merged)
+
+    def remote(self, *args, **kwargs):
+        w = worker_mod.global_worker()
+        opts = self._options
+        resources: Dict[str, float] = dict(opts.get("resources") or {})
+        num_cpus = opts.get("num_cpus")
+        num_tpus = opts.get("num_tpus")
+        resources.setdefault("CPU", 1.0 if num_cpus is None else float(num_cpus))
+        if num_tpus:
+            resources["TPU"] = float(num_tpus)
+        num_returns = opts.get("num_returns", 1)
+        refs = w.submit_task(
+            self._fn,
+            args,
+            kwargs,
+            num_returns=num_returns,
+            resources=resources,
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            max_retries=opts.get("max_retries"),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            runtime_env=opts.get("runtime_env"),
+            function_name=self._fn.__name__,
+        )
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def underlying_function(self):
+        return self._fn

@@ -1,0 +1,38 @@
+"""Test fixtures (reference: python/ray/tests/conftest.py — ray_start_regular
+etc. built on cluster_utils starting real processes per simulated node).
+
+JAX tests run on a virtual 8-device CPU mesh: env must be set before jax is
+first imported anywhere in the test process.
+"""
+
+import os
+
+# Virtual 8-device CPU mesh. Note: this jax build's axon plugin ignores the
+# JAX_PLATFORMS env var, so tests must ALSO call jax.config.update — done here
+# before any test imports jax transitively.
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def ray_cluster():
+    """A live single-node cluster (GCS + nodelet subprocesses), shared per
+    test module for speed; small object store to keep startup fast."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_regular(ray_cluster):
+    return ray_cluster

@@ -1,0 +1,137 @@
+"""Actor tests (reference: python/ray/tests/test_actor*.py)."""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_actor_basic(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.x = start
+
+        def incr(self, by=1):
+            self.x += by
+            return self.x
+
+    c = Counter.remote(5)
+    assert ray_tpu.get(c.incr.remote()) == 6
+    assert ray_tpu.get(c.incr.remote(10)) == 16
+
+
+def test_actor_call_ordering(ray_start_regular):
+    @ray_tpu.remote
+    class Seq:
+        def __init__(self):
+            self.log = []
+
+        def push(self, i):
+            self.log.append(i)
+            return list(self.log)
+
+    s = Seq.remote()
+    refs = [s.push.remote(i) for i in range(10)]
+    final = ray_tpu.get(refs)[-1]
+    assert final == list(range(10))
+
+
+def test_actor_state_isolation(ray_start_regular):
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    a, b = Holder.remote(), Holder.remote()
+    ray_tpu.get([a.set.remote(1), b.set.remote(2)])
+    assert ray_tpu.get([a.get.remote(), b.get.remote()]) == [1, 2]
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor-bang")
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.RayTaskError, match="actor-bang"):
+        ray_tpu.get(b.fail.remote())
+
+
+def test_named_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Registry:
+        def whoami(self):
+            return "registry"
+
+    Registry.options(name="test_named_registry").remote()
+    h = ray_tpu.get_actor("test_named_registry")
+    assert ray_tpu.get(h.whoami.remote()) == "registry"
+
+
+def test_duplicate_name_rejected(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def f(self):
+            return 1
+
+    A.options(name="dup_name_actor").remote()
+    with pytest.raises(ValueError):
+        A.options(name="dup_name_actor").remote()
+
+
+def test_async_actor_concurrency(ray_start_regular):
+    @ray_tpu.remote
+    class AsyncWorker:
+        async def work(self, t):
+            await asyncio.sleep(t)
+            return t
+
+    a = AsyncWorker.options(max_concurrency=8).remote()
+    ray_tpu.get(a.work.remote(0.01))  # warm-up: actor creation + worker spawn
+    t0 = time.time()
+    ray_tpu.get([a.work.remote(0.4) for _ in range(8)])
+    assert time.time() - t0 < 8 * 0.4 / 2
+
+
+def test_actor_handle_passing(ray_start_regular):
+    @ray_tpu.remote
+    class Target:
+        def __init__(self):
+            self.v = 0
+
+        def bump(self):
+            self.v += 1
+            return self.v
+
+    @ray_tpu.remote
+    def call_through(handle):
+        return ray_tpu.get(handle.bump.remote())
+
+    t = Target.remote()
+    assert ray_tpu.get(call_through.remote(t)) == 1
+    assert ray_tpu.get(t.bump.remote()) == 2
+
+
+def test_kill_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "alive"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote()) == "alive"
+    ray_tpu.kill(v)
+    time.sleep(1)
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.ActorUnavailableError)):
+        ray_tpu.get(v.ping.remote(), timeout=15)

@@ -1,0 +1,126 @@
+"""Core runtime tests: tasks, objects, errors (reference test strategy:
+python/ray/tests/test_basic*.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_task_roundtrip(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_chaining(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(4):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 5
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"k": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"k": [1, 2, 3]}
+
+
+def test_large_object_zero_copy(ray_start_regular):
+    arr = np.random.rand(512, 512)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_large_task_io(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    arr = np.ones((1000, 500))
+    np.testing.assert_array_equal(ray_tpu.get(double.remote(arr)), arr * 2)
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bang")
+
+    with pytest.raises(ray_tpu.RayTaskError, match="bang"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("dep-bang")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast, stuck = slow.remote(0.05), slow.remote(30)
+    ready, not_ready = ray_tpu.wait([fast, stuck], num_returns=1, timeout=10)
+    assert ready == [fast]
+    assert not_ready == [stuck]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(60)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(forever.remote(), timeout=1)
+
+
+def test_nested_refs_in_container(ray_start_regular):
+    inner = ray_tpu.put(41)
+
+    @ray_tpu.remote
+    def unwrap(container):
+        return ray_tpu.get(container["ref"]) + 1
+
+    assert ray_tpu.get(unwrap.remote({"ref": inner})) == 42
+
+
+def test_parallelism(ray_start_regular):
+    @ray_tpu.remote
+    def sleep_half():
+        time.sleep(0.5)
+
+    t0 = time.time()
+    ray_tpu.get([sleep_half.remote() for _ in range(4)])
+    assert time.time() - t0 < 4 * 0.5
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU") == 8.0

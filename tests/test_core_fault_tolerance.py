@@ -1,0 +1,86 @@
+"""Fault tolerance: task retries, actor restarts, death detection (reference:
+python/ray/tests/test_actor_failures.py, test_task_retries)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_task_retry_on_worker_crash(ray_start_regular, tmp_path):
+    marker = str(tmp_path / "flaky_marker")
+
+    @ray_tpu.remote
+    def flaky(path):
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)
+        return "survived"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=60) == "survived"
+
+
+def test_task_no_retry_exhausted(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def die():
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(die.remote(), timeout=60)
+
+
+def test_actor_restart(ray_start_regular):
+    @ray_tpu.remote
+    class Phoenix:
+        def pid(self):
+            return os.getpid()
+
+        def die(self):
+            os._exit(1)
+
+    p = Phoenix.options(max_restarts=2).remote()
+    pid1 = ray_tpu.get(p.pid.remote(), timeout=60)
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(p.die.remote(), timeout=30)
+    deadline = time.time() + 30
+    pid2 = None
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(p.pid.remote(), timeout=20)
+            break
+        except ray_tpu.RayTpuError:
+            time.sleep(0.5)
+    assert pid2 is not None and pid2 != pid1
+
+
+def test_actor_death_permanent(ray_start_regular):
+    @ray_tpu.remote
+    class Mortal:
+        def die(self):
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    m = Mortal.remote()  # max_restarts=0
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(m.die.remote(), timeout=30)
+    time.sleep(1.5)
+    with pytest.raises((ray_tpu.ActorDiedError, ray_tpu.ActorUnavailableError)):
+        ray_tpu.get(m.ping.remote(), timeout=20)
+
+
+def test_actor_creation_failure_surfaces(ray_start_regular):
+    @ray_tpu.remote
+    class BadInit:
+        def __init__(self):
+            raise RuntimeError("init-bang")
+
+        def f(self):
+            return 1
+
+    b = BadInit.remote()
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(b.f.remote(), timeout=60)

@@ -1,0 +1,99 @@
+"""Native shm store unit tests (reference test analog:
+src/ray/object_manager/plasma tests + test_object_store.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization as ser
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+@pytest.fixture
+def store(tmp_path):
+    path = f"/dev/shm/ray_tpu_test_{os.getpid()}_{os.urandom(4).hex()}"
+    s = SharedMemoryStore(path, capacity=32 * 1024 * 1024, create=True)
+    yield s
+    s.close(unmap=True)
+    os.unlink(path)
+
+
+_TID = TaskID(b"\x01" * 12 + JobID.from_int(1).binary())
+
+
+def _oid(i=0):
+    # Deterministic: TaskID.for_task is random per call, so ids must be derived
+    # from a fixed task for lookups made with freshly-built ObjectIDs to match.
+    return ObjectID.for_put(_TID, i)
+
+
+def test_put_get_raw(store):
+    oid = _oid()
+    assert store.put_raw(oid, [b"hello", b"world"])
+    view = store.get_raw(oid)
+    assert bytes(view) == b"helloworld"
+    store.release(oid)
+
+
+def test_put_duplicate_returns_false(store):
+    oid = _oid()
+    assert store.put_raw(oid, [b"x"])
+    assert not store.put_raw(oid, [b"y"])
+
+
+def test_serialized_roundtrip(store):
+    oid = _oid()
+    arr = np.arange(10000, dtype=np.int64)
+    store.put_serialized(oid, ser.serialize({"a": arr}))
+    out = ser.deserialize(store.get_serialized(oid))
+    np.testing.assert_array_equal(out["a"], arr)
+    store.release(oid)
+
+
+def test_missing_object(store):
+    assert store.get_raw(_oid(123)) is None
+    assert not store.contains(_oid(123))
+
+
+def test_lru_eviction_under_pressure(store):
+    # 32MB store, write 40 x 1MB: early unpinned objects must be evicted.
+    for i in range(40):
+        store.put_raw(_oid(i), [b"z" * (1024 * 1024)])
+    assert store.contains(_oid(39))
+    assert not store.contains(_oid(0))
+
+
+def test_oversized_object_raises(store):
+    with pytest.raises(ObjectStoreFullError):
+        store.put_raw(_oid(7), [b"x" * (64 * 1024 * 1024)])
+
+
+def test_pinned_objects_survive_pressure(store):
+    pinned = _oid(999)
+    store.put_raw(pinned, [b"p" * 1024])
+    view = store.get_raw(pinned)  # pin it
+    for i in range(40):
+        store.put_raw(_oid(i), [b"z" * (1024 * 1024)])
+    assert store.contains(pinned)
+    assert bytes(view[:1]) == b"p"
+    store.release(pinned)
+
+
+def test_delete(store):
+    oid = _oid(5)
+    store.put_raw(oid, [b"bye"])
+    store.delete(oid)
+    assert not store.contains(oid)
+
+
+def test_cross_handle_visibility(store):
+    other = SharedMemoryStore(store.path)
+    oid = _oid(77)
+    store.put_raw(oid, [b"shared"])
+    view = other.get_raw(oid)
+    assert bytes(view) == b"shared"
+    other.release(oid)
+    other.close()
