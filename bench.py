@@ -1,0 +1,100 @@
+"""Benchmark driver. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Headline metric: 1:1 sync actor round-trips/s — the reference's own headline
+microbenchmark ("1_1_actor_calls_sync" in release/perf_metrics/
+microbenchmark.json, driver python/ray/_private/ray_perf.py). Baseline:
+1,959.6 ops/s on release infra (see BASELINE.md).
+
+Extra metrics (actor async throughput, task throughput, put bandwidth) go to
+stderr so the stdout contract stays one line.
+"""
+
+import json
+import sys
+import time
+
+BASELINE_1_1_ACTOR_CALLS_SYNC = 1959.6
+
+
+def bench_actor_calls_sync(ray_tpu, n=2000):
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return None
+
+    a = Echo.remote()
+    ray_tpu.get(a.ping.remote())  # warm-up: actor creation + worker spawn
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(a.ping.remote())
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def bench_actor_calls_async(ray_tpu, n=5000):
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return None
+
+    a = Echo.remote()
+    ray_tpu.get(a.ping.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([a.ping.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def bench_tasks_async(ray_tpu, n=2000):
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([nop.remote() for _ in range(n)])
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def bench_put_gigabytes(ray_tpu, size_mb=100, iters=10):
+    import numpy as np
+
+    arr = np.ones(size_mb * 1024 * 1024, dtype=np.uint8)
+    ray_tpu.put(arr)  # warm-up (prefault)
+    time.sleep(1.0)
+    t0 = time.perf_counter()
+    refs = [ray_tpu.put(arr) for _ in range(iters)]
+    dt = time.perf_counter() - t0
+    del refs
+    return size_mb * iters / 1024 / dt
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(object_store_memory=2 * 1024 * 1024 * 1024)
+    try:
+        sync_rate = bench_actor_calls_sync(ray_tpu)
+        async_rate = bench_actor_calls_async(ray_tpu)
+        task_rate = bench_tasks_async(ray_tpu)
+        put_gbps = bench_put_gigabytes(ray_tpu)
+        print(
+            f"1_1_actor_calls_async: {async_rate:.1f}/s (ref 8219.8)\n"
+            f"single_client_tasks_async: {task_rate:.1f}/s (ref 7971.8)\n"
+            f"single_client_put_gigabytes: {put_gbps:.2f} GiB/s (ref 19.56)",
+            file=sys.stderr,
+        )
+        print(json.dumps({
+            "metric": "1_1_actor_calls_sync",
+            "value": round(sync_rate, 1),
+            "unit": "ops/s",
+            "vs_baseline": round(sync_rate / BASELINE_1_1_ACTOR_CALLS_SYNC, 3),
+        }))
+    finally:
+        ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

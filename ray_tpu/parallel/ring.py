@@ -87,7 +87,7 @@ def ring_attention(
 ) -> jax.Array:
     """Exact attention with sequence parallelism. Inputs sharded
     [batch over data/fsdp, seq over `axis_name`, heads over tensor, D]."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     batch_spec = tuple(a for a in batch_axes if a in mesh.axis_names
                        and mesh.shape[a] > 1)
@@ -99,5 +99,5 @@ def ring_attention(
         scale=scale, use_flash_block=False)
     return shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False,
+        check_vma=False,
     )(q, k, v)

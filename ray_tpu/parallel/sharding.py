@@ -97,9 +97,41 @@ class ParamShardingRules:
         def one(path, leaf):
             path_str = "/".join(_key_str(k) for k in path)
             axes = self.logical_axes(path_str, getattr(leaf, "ndim", 0))
-            return sharding_for(mesh, axes, self._rules)
+            spec = spec_for(axes, self._rules, mesh)
+            spec = _drop_indivisible(spec, getattr(leaf, "shape", ()), mesh)
+            return NamedSharding(mesh, spec)
 
         return jax.tree_util.tree_map_with_path(one, params)
+
+
+def _drop_indivisible(spec: PartitionSpec, shape: Sequence[int],
+                      mesh: Mesh) -> PartitionSpec:
+    """Replicate any dimension whose size a mapped mesh axis doesn't divide
+    (e.g. 2 KV heads on tensor=4): sharding there would be an error, and
+    replication is the correct degradation for small dims."""
+    sizes = mesh_shape(mesh)
+    out = []
+    for i, entry in enumerate(spec):
+        if entry is None or i >= len(shape):
+            out.append(entry)
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        total = 1
+        kept = []
+        for a in axes:
+            n = sizes.get(a, 1)
+            if shape[i] % (total * n) == 0:
+                kept.append(a)
+                total *= n
+        if not kept:
+            out.append(None)
+        elif len(kept) == 1:
+            out.append(kept[0])
+        else:
+            out.append(tuple(kept))
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
 
 
 def _key_str(k: Any) -> str:

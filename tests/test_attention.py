@@ -1,0 +1,56 @@
+"""Attention op tests: flash (pallas, interpret on CPU) and ring attention vs
+the plain softmax oracle (reference test analog: vLLM kernel tests — here
+net-new, SURVEY §7.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.ops.attention import attention_reference, flash_attention
+from ray_tpu.parallel.mesh import create_mesh
+from ray_tpu.parallel.ring import ring_attention
+
+
+def _qkv(b=2, sq=256, h=4, hkv=2, d=32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, sq, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, sq, hkv, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, sq, hkv, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = attention_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_gqa_matches_mha():
+    q, k, v = _qkv(h=4, hkv=1)
+    ref = attention_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_reference(causal):
+    mesh = create_mesh({"seq": 4, "data": 2})
+    q, k, v = _qkv(b=2, sq=256, h=4, hkv=4, d=32)
+    ref = attention_reference(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh=mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_attention_gqa():
+    mesh = create_mesh({"seq": 8})
+    q, k, v = _qkv(b=1, sq=512, h=8, hkv=2, d=32, seed=3)
+    ref = attention_reference(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh=mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
