@@ -62,8 +62,7 @@ def bench_put_gigabytes(ray_tpu, size_mb=100, iters=10):
     import numpy as np
 
     arr = np.ones(size_mb * 1024 * 1024, dtype=np.uint8)
-    ray_tpu.put(arr)  # warm-up (prefault)
-    time.sleep(1.0)
+    ray_tpu.put(arr)  # warm-up
     t0 = time.perf_counter()
     refs = [ray_tpu.put(arr) for _ in range(iters)]
     dt = time.perf_counter() - t0
@@ -76,6 +75,11 @@ def main():
 
     ray_tpu.init(object_store_memory=2 * 1024 * 1024 * 1024)
     try:
+        # Let the store's background page-population finish so fault churn
+        # doesn't pollute the latency benches (matters on low-core hosts).
+        from ray_tpu._private import worker as _worker_mod
+
+        _worker_mod.global_worker().shm.wait_prefault(60)
         sync_rate = bench_actor_calls_sync(ray_tpu)
         async_rate = bench_actor_calls_async(ray_tpu)
         task_rate = bench_tasks_async(ray_tpu)

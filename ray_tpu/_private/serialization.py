@@ -99,7 +99,14 @@ def serialize(value: Any) -> SerializedObject:
     file = io.BytesIO()
     pickler = _Pickler(file, oob.append)
     pickler.dump(value)
-    buffers = [file.getvalue()] + [b.raw().tobytes() for b in oob]
+    # Keep out-of-band buffers as memoryviews (zero-copy): the view pins the
+    # source array and the bytes land in the shm arena / on the wire directly.
+    buffers: List[Any] = [file.getvalue()]
+    for b in oob:
+        try:
+            buffers.append(b.raw())
+        except BufferError:  # non-contiguous source
+            buffers.append(memoryview(b).tobytes())
     return SerializedObject(METADATA_PICKLE, buffers, pickler.found_refs)
 
 

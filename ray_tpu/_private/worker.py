@@ -197,22 +197,54 @@ class ActorSubmitter:
         if self._pump_task is None or self._pump_task.done():
             self._pump_task = asyncio.ensure_future(self._pump())
 
+    MAX_BATCH = 32
+
     async def _pump(self) -> None:
         while not self.queue.empty():
-            spec, retries, attempt = self.queue.get_nowait()
+            # Adaptive batching: drain whatever is queued (up to MAX_BATCH)
+            # into one RPC frame — collapses per-call frame/syscall/task
+            # overhead for pipelined submitters while a lone call still goes
+            # out immediately as a batch of one.
+            batch = []
+            while len(batch) < self.MAX_BATCH and not self.queue.empty():
+                batch.append(self.queue.get_nowait())
             try:
                 client = await self._ensure_client()
-                fut = await client.start_call("push_actor_task",
-                                              spec=ser_spec(spec))
+                if len(batch) == 1:
+                    spec, retries, attempt = batch[0]
+                    fut = await client.start_call("push_actor_task",
+                                                  spec=ser_spec(spec))
+                else:
+                    fut = await client.start_call(
+                        "push_actor_task_batch",
+                        specs=[ser_spec(s) for s, _, _ in batch])
             except (ConnectionLost, asyncio.TimeoutError) as e:
-                await self._on_send_failure(spec, retries, attempt, e)
+                for spec, retries, attempt in batch:
+                    await self._on_send_failure(spec, retries, attempt, e)
                 continue
             except (ActorDiedError, ActorUnavailableError) as e:
-                self.worker.task_manager.fail_permanently(
-                    spec.task_id, ser.serialize_error(e))
+                for spec, _, _ in batch:
+                    self.worker.task_manager.fail_permanently(
+                        spec.task_id, ser.serialize_error(e))
                 continue
-            asyncio.ensure_future(
-                self._handle_reply(spec, retries, attempt, fut))
+            if len(batch) == 1:
+                spec, retries, attempt = batch[0]
+                asyncio.ensure_future(
+                    self._handle_reply(spec, retries, attempt, fut))
+            else:
+                asyncio.ensure_future(self._handle_batch_reply(batch, fut))
+
+    async def _handle_batch_reply(self, batch, fut: "asyncio.Future") -> None:
+        try:
+            reply = await asyncio.wait_for(fut, 86400.0)
+        except (ConnectionLost, RemoteError, asyncio.TimeoutError) as e:
+            for spec, retries, attempt in batch:
+                await self._on_send_failure(spec, retries, attempt, e)
+            if self._pump_task is None or self._pump_task.done():
+                self._pump_task = asyncio.ensure_future(self._pump())
+            return
+        for (spec, _, _), item in zip(batch, reply["replies"]):
+            await self.worker.handle_task_reply(spec, item)
 
     async def _on_send_failure(self, spec: TaskSpec, retries: int,
                                attempt: int, exc: BaseException) -> None:
@@ -380,6 +412,7 @@ class Worker:
         s.register("push_task", self._rpc_push_task)
         s.register("create_actor", self._rpc_create_actor)
         s.register("push_actor_task", self._rpc_push_actor_task)
+        s.register("push_actor_task_batch", self._rpc_push_actor_task_batch)
         s.register("get_object", self._rpc_get_object)
         s.register("wait_object", self._rpc_wait_object)
         s.register("add_borrows", self._rpc_add_borrows)
@@ -429,6 +462,26 @@ class Worker:
         return ref
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        # Fast path: every ref already resolved locally (memory store value or
+        # local shm) — deserialize on the calling thread, no loop round trip.
+        objs = []
+        for ref in refs:
+            entry = self.memory_store.get_if_exists(ref.id)
+            if isinstance(entry, ser.SerializedObject):
+                objs.append(entry)
+                continue
+            obj = self.shm.get_serialized(ref.id)
+            if obj is None:
+                break
+            objs.append(obj)
+        if len(objs) == len(refs):
+            out = []
+            for obj in objs:
+                value, is_error = ser.deserialize_or_error(obj)
+                if is_error:
+                    raise value
+                out.append(value)
+            return out
         coro = self._get_async(refs, timeout)
         outer = None if timeout is None else timeout + 5
         return self.loop_thread.run(coro, timeout=outer)
@@ -839,6 +892,15 @@ class Worker:
             logger.exception("actor creation failed")
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
+    async def _rpc_push_actor_task_batch(self, specs: List[bytes]) -> Dict[str, Any]:
+        """Execute a batch of actor tasks. Per-item logic is reused; gather
+        starts the items in order, so the (max_workers=1) actor executor sees
+        them in seq order and sync-actor ordering is preserved, while async
+        actor methods still interleave up to max_concurrency."""
+        replies = await asyncio.gather(
+            *[self._rpc_push_actor_task(s) for s in specs])
+        return {"replies": list(replies)}
+
     async def _rpc_push_actor_task(self, spec: bytes) -> Dict[str, Any]:
         task_spec = deser_spec(spec)
         if self._actor_instance is None:
@@ -895,6 +957,11 @@ class Worker:
             self._current_task_id = None
 
     def _resolve_spec_args_sync(self, spec: TaskSpec) -> Tuple[list, dict]:
+        # Fast path: no ref args → pure deserialization, skip the loop hop.
+        if (all(a[0] == "value" for a in spec.args)
+                and all(v[0] == "value" for v in spec.kwargs.values())):
+            return ([ser.deserialize(a[1]) for a in spec.args],
+                    {k: ser.deserialize(v[1]) for k, v in spec.kwargs.items()})
         return self.loop_thread.run(self._resolve_spec_args(spec))
 
     async def _resolve_spec_args(self, spec: TaskSpec) -> Tuple[list, dict]:
