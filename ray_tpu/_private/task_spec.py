@@ -115,9 +115,11 @@ class TaskSpec:
 
     def scheduling_key(self) -> Tuple:
         """Lease-reuse key (reference: SchedulingKey in
-        normal_task_submitter.h:44 — resource shape + runtime env)."""
+        normal_task_submitter.h:44 — resource shape + runtime env + strategy).
+        The full strategy identity matters: PG bundles with different indexes
+        or different affinity nodes must not share a lease pool."""
         env_key = repr(sorted((self.runtime_env or {}).items()))
-        return (self.resources.key(), env_key, type(self.scheduling_strategy).__name__)
+        return (self.resources.key(), env_key, repr(self.scheduling_strategy))
 
     def return_ids(self) -> List[ObjectID]:
         return [

@@ -45,8 +45,10 @@ from ray_tpu._private.rpc import (
 from ray_tpu._private.task_manager import TaskManager
 from ray_tpu._private.task_spec import (
     DefaultStrategy,
+    NodeAffinityStrategy,
     PlacementGroupStrategy,
     ResourceSet,
+    SpreadStrategy,
     TaskSpec,
     TaskType,
 )
@@ -118,20 +120,94 @@ class LeasePool:
             self.requesting += 1
             asyncio.ensure_future(self._acquire_and_pump())
 
+    async def _resolve_target_nodelet(self):
+        """Cluster scheduling (reference: two-level scheduling, SURVEY C15):
+        pick the nodelet to lease from based on the scheduling strategy.
+        Returns (nodelet_client, pg_bundle) or (None, None) when nothing
+        fits right now."""
+        w = self.worker
+        if isinstance(self.strategy, PlacementGroupStrategy):
+            pg_bundle = (self.strategy.placement_group_id,
+                         max(self.strategy.bundle_index, 0))
+            pg = await w.gcs_client.call(
+                "get_placement_group", pg_id=self.strategy.placement_group_id)
+            if pg is None or pg["state"] != "CREATED":
+                return None, None
+            node_id = pg["bundle_nodes"].get(pg_bundle[1])
+            if node_id is None:
+                return None, None
+            client = await w.nodelet_client_for_node(node_id)
+            return client, pg_bundle
+        if isinstance(self.strategy, NodeAffinityStrategy):
+            client = await w.nodelet_client_for_node(
+                bytes.fromhex(self.strategy.node_id))
+            return client, None
+        if isinstance(self.strategy, SpreadStrategy):
+            pick = await w.gcs_client.call(
+                "pick_node", resources=self.resources, strategy="spread")
+            if pick is None:
+                return None, None
+            return await w.nodelet_client_for_node(pick["node_id"]), None
+        # Default (hybrid): locality first — try the local nodelet without
+        # blocking; spill to a GCS-picked node when local is saturated
+        # (reference: lease spillback, normal_task_submitter.h:79).
+        return w.nodelet_client, None
+
+    async def _lease_once(self):
+        """One lease attempt. Returns (lease_reply, nodelet_client)."""
+        w = self.worker
+        client, pg_bundle = await self._resolve_target_nodelet()
+        if client is None:
+            return {"ok": False, "error": "no feasible node", "retry": True}, None
+        timeout = get_config().worker_start_timeout_s + 5
+        if client is w.nodelet_client and not isinstance(
+                self.strategy, (PlacementGroupStrategy, NodeAffinityStrategy)):
+            # Spillback (reference: ClusterTaskManager spillback + lease
+            # retries): probe non-blocking, local node first — the nodelets'
+            # own accounting is exact where the GCS heartbeat view is ~1s
+            # stale — and keep sweeping until something grants or we time out.
+            deadline = time.monotonic() + get_config().worker_start_timeout_s
+            backoff = 0.05
+            while True:
+                lease = await client.call(
+                    "lease_worker", resources=self.resources,
+                    runtime_env=self.runtime_env, lifetime="task",
+                    pg_bundle=pg_bundle, block=False, timeout=timeout)
+                if lease.get("ok"):
+                    return lease, client
+                nodes = await w.gcs_client.call("list_nodes")
+                others = [n for n in nodes if n["alive"]
+                          and n["node_id"] != w.node_id.binary()]
+                if not others:
+                    # Single-node cluster: block on the local nodelet (event-
+                    # driven wakeup) instead of polling.
+                    lease = await client.call(
+                        "lease_worker", resources=self.resources,
+                        runtime_env=self.runtime_env, lifetime="task",
+                        pg_bundle=pg_bundle, block=True, timeout=timeout)
+                    return lease, client
+                for n in others:
+                    remote = await w.nodelet_client_for_node(n["node_id"])
+                    lease = await remote.call(
+                        "lease_worker", resources=self.resources,
+                        runtime_env=self.runtime_env, lifetime="task",
+                        pg_bundle=pg_bundle, block=False, timeout=timeout)
+                    if lease.get("ok"):
+                        return lease, remote
+                if time.monotonic() > deadline:
+                    return {"ok": False, "error": "lease timeout",
+                            "retry": True}, None
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+        lease = await client.call(
+            "lease_worker", resources=self.resources,
+            runtime_env=self.runtime_env, lifetime="task",
+            pg_bundle=pg_bundle, block=True, timeout=timeout)
+        return lease, client
+
     async def _acquire_and_pump(self) -> None:
         try:
-            pg_bundle = None
-            if isinstance(self.strategy, PlacementGroupStrategy):
-                pg_bundle = (self.strategy.placement_group_id,
-                             max(self.strategy.bundle_index, 0))
-            lease = await self.worker.nodelet_client.call(
-                "lease_worker",
-                resources=self.resources,
-                runtime_env=self.runtime_env,
-                lifetime="task",
-                pg_bundle=pg_bundle,
-                timeout=get_config().worker_start_timeout_s + 5,
-            )
+            lease, nodelet = await self._lease_once()
         except Exception as e:
             logger.warning("lease request failed: %r", e)
             self.requesting -= 1
@@ -168,8 +244,7 @@ class LeasePool:
             self.num_leased -= 1
             await client.close()
             try:
-                await self.worker.nodelet_client.call(
-                    "return_worker", worker_id=worker_id)
+                await nodelet.call("return_worker", worker_id=worker_id)
             except Exception:
                 pass
             if not self.queue.empty():
@@ -355,6 +430,8 @@ class Worker:
         self._lease_pools: Dict[Tuple, LeasePool] = {}
         self._actor_submitters: Dict[ActorID, ActorSubmitter] = {}
         self._actor_seq_nos: Dict[ActorID, int] = {}
+        # Remote nodelet clients for cluster-wide leasing, keyed by node id.
+        self._nodelet_clients: Dict[bytes, RpcClient] = {}
         # Execution side.
         self._task_executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="task_exec")
@@ -386,6 +463,22 @@ class Worker:
         self.connected = True
         set_global_worker(self)
 
+    async def nodelet_client_for_node(self, node_id: bytes) -> RpcClient:
+        """Cached RPC client to any node's nodelet (for spillback / PG /
+        node-affinity leases). The local nodelet reuses the primary client."""
+        if self.node_id is not None and node_id == self.node_id.binary():
+            return self.nodelet_client
+        client = self._nodelet_clients.get(node_id)
+        if client is not None:
+            return client
+        nodes = await self.gcs_client.call("list_nodes")
+        info = next((n for n in nodes if n["node_id"] == node_id), None)
+        if info is None:
+            raise ObjectLostError(f"node {node_id.hex()[:12]} not in cluster")
+        client = RpcClient(*info["address"], name="nodelet-remote")
+        self._nodelet_clients[node_id] = client
+        return client
+
     def disconnect(self) -> None:
         if not self.connected:
             return
@@ -396,6 +489,8 @@ class Worker:
                 await self.gcs_client.close()
             if self.nodelet_client:
                 await self.nodelet_client.close()
+            for c in self._nodelet_clients.values():
+                await c.close()
             await self.server.stop()
 
         try:

@@ -95,6 +95,8 @@ class ActorClass:
         if num_tpus:
             resources["TPU"] = float(num_tpus)
         lifetime = opts.get("lifetime")
+        from ray_tpu.util.scheduling_strategies import to_internal
+
         actor_id = w.create_actor(
             self._cls,
             args,
@@ -106,7 +108,7 @@ class ActorClass:
             max_concurrency=int(opts.get("max_concurrency", 1)),
             detached=(lifetime == "detached"),
             runtime_env=opts.get("runtime_env"),
-            scheduling_strategy=opts.get("scheduling_strategy"),
+            scheduling_strategy=to_internal(opts.get("scheduling_strategy")),
         )
         return ActorHandle(
             actor_id,

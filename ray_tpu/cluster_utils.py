@@ -1,0 +1,77 @@
+"""In-process multi-node test clusters (reference: python/ray/cluster_utils.py
+:26,135 — Cluster starts real raylet+GCS processes per simulated node on one
+machine; same here with GCS + one nodelet subprocess per node)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.node import Node
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class Cluster:
+    """Start a head node, then add_node() simulated worker nodes. Each node is
+    a real nodelet subprocess with its own shm store and worker pool."""
+
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[Dict[str, Any]] = None):
+        self.head_node: Optional[Node] = None
+        self.worker_nodes: List[Node] = []
+        self._node_counter = 0
+        if initialize_head:
+            self.head_node = self.add_node(**(head_node_args or {}))
+
+    @property
+    def address(self) -> str:
+        assert self.head_node is not None
+        return f"{self.head_node.gcs_address[0]}:{self.head_node.gcs_address[1]}"
+
+    @property
+    def gcs_address(self):
+        assert self.head_node is not None
+        return self.head_node.gcs_address
+
+    def add_node(self, num_cpus: float = 4.0,
+                 resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: Optional[int] = None,
+                 node_name: str = "") -> Node:
+        self._node_counter += 1
+        total = {"CPU": float(num_cpus)}
+        for k, v in (resources or {}).items():
+            total[k] = float(v)
+        node = Node(
+            head=self.head_node is None,
+            gcs_address=None if self.head_node is None
+            else self.head_node.gcs_address,
+            resources=total,
+            object_store_memory=object_store_memory,
+            session_dir=(self.head_node.session_dir
+                         if self.head_node is not None else None),
+            node_name=node_name or f"node{self._node_counter}",
+        )
+        if self.head_node is not None:
+            self.worker_nodes.append(node)
+        return node
+
+    def remove_node(self, node: Node) -> None:
+        """Hard-kill a node's processes (fault-injection for tests)."""
+        node.shutdown()
+        if node in self.worker_nodes:
+            self.worker_nodes.remove(node)
+
+    def connect(self, **init_kwargs):
+        """ray_tpu.init() against this cluster's head node."""
+        import ray_tpu
+
+        return ray_tpu.init(address=self.address, **init_kwargs)
+
+    def shutdown(self) -> None:
+        for node in self.worker_nodes:
+            node.shutdown()
+        self.worker_nodes.clear()
+        if self.head_node is not None:
+            self.head_node.shutdown()
+            self.head_node = None

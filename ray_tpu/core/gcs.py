@@ -155,6 +155,7 @@ class GcsServer:
         self._nodelet_clients: Dict[NodeID, RpcClient] = {}
         self._background: List[asyncio.Task] = []
         self._actor_locks: Dict[ActorID, asyncio.Lock] = {}
+        self._spread_rr = 0
 
     async def start(self) -> Tuple[str, int]:
         for name in dir(self):
@@ -162,6 +163,7 @@ class GcsServer:
                 self.server.register(name[4:], getattr(self, name))
         addr = await self.server.start()
         self._background.append(asyncio.ensure_future(self._health_check_loop()))
+        self._background.append(asyncio.ensure_future(self._pg_retry_loop()))
         logger.info("GCS listening on %s:%d", *addr)
         return addr
 
@@ -325,9 +327,15 @@ class GcsServer:
             ]
             return max(used) if used else 0.0
 
-        reverse = strategy != "spread"
+        if strategy == "spread":
+            # Round-robin among the least-utilized candidates: a pure
+            # utilization sort is deterministic between heartbeats, which
+            # would send every pick in a burst to the same node.
+            candidates.sort(key=lambda n: (utilization(n), n.node_id.hex()))
+            self._spread_rr += 1
+            return candidates[self._spread_rr % len(candidates)]
         return sorted(candidates, key=lambda n: (utilization(n), n.node_id.hex()),
-                      reverse=reverse)[0]
+                      reverse=True)[0]
 
     async def rpc_pick_node(
         self, resources: Dict[str, float], strategy: str = "hybrid",
@@ -369,12 +377,38 @@ class GcsServer:
     async def _schedule_actor_locked(self, info: ActorInfo) -> None:
         import pickle
 
+        from ray_tpu._private.task_spec import (NodeAffinityStrategy,
+                                                PlacementGroupStrategy,
+                                                SpreadStrategy)
+
         spec = pickle.loads(info.creation_spec)
         cfg = get_config()
         backoff = cfg.retry_backoff_initial_s
         deadline = time.monotonic() + cfg.worker_start_timeout_s
+        strategy = spec.scheduling_strategy
         while info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
-            node = self._pick_node(spec.resources)
+            pg_bundle = None
+            if isinstance(strategy, PlacementGroupStrategy):
+                pgid = PlacementGroupID(strategy.placement_group_id)
+                pg = self.placement_groups.get(pgid)
+                bundle_idx = max(strategy.bundle_index, 0)
+                nid = (pg.bundle_nodes.get(bundle_idx)
+                       if pg is not None and pg.state == "CREATED" else None)
+                node = self.nodes.get(nid) if nid is not None else None
+                if node is not None and not node.alive:
+                    node = None
+                pg_bundle = (strategy.placement_group_id, bundle_idx)
+            elif isinstance(strategy, NodeAffinityStrategy):
+                nid = NodeID(bytes.fromhex(strategy.node_id))
+                node = self.nodes.get(nid)
+                if node is not None and not node.alive:
+                    node = None
+                if node is None and strategy.soft:
+                    node = self._pick_node(spec.resources)
+            elif isinstance(strategy, SpreadStrategy):
+                node = self._pick_node(spec.resources, strategy="spread")
+            else:
+                node = self._pick_node(spec.resources)
             if node is None:
                 if time.monotonic() > deadline:
                     await self._actor_dead(
@@ -390,6 +424,7 @@ class GcsServer:
                     resources=dict(spec.resources),
                     runtime_env=spec.runtime_env,
                     lifetime="actor",
+                    pg_bundle=pg_bundle,
                     timeout=cfg.worker_start_timeout_s,
                 )
                 if not lease.get("ok"):
@@ -515,8 +550,26 @@ class GcsServer:
             return {"ok": True,
                     "bundle_nodes": {i: nid.binary()
                                      for i, nid in info.bundle_nodes.items()}}
-        info.state = "INFEASIBLE"
-        return {"ok": False, "error": "infeasible placement group"}
+        # Stay PENDING: the retry loop re-schedules as the resource view
+        # refreshes / nodes join (reference: GcsPlacementGroupManager retry
+        # queue). Permanent infeasibility is indistinguishable from "not yet".
+        return {"ok": False, "error": "placement group pending", "retry": True}
+
+    async def _pg_retry_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            for info in list(self.placement_groups.values()):
+                if info.state != "PENDING":
+                    continue
+                try:
+                    if await self._schedule_pg(info):
+                        info.state = "CREATED"
+                        await self.pubsub.publish(
+                            "placement_groups",
+                            {"event": "created",
+                             "pg_id": info.pg_id.binary()})
+                except Exception as e:
+                    logger.warning("pg retry failed: %r", e)
 
     async def _schedule_pg(self, info: PlacementGroupInfo) -> bool:
         # Choose nodes per bundle under the strategy.

@@ -34,13 +34,15 @@ class RemoteFunction:
         if num_tpus:
             resources["TPU"] = float(num_tpus)
         num_returns = opts.get("num_returns", 1)
+        from ray_tpu.util.scheduling_strategies import to_internal
+
         refs = w.submit_task(
             self._fn,
             args,
             kwargs,
             num_returns=num_returns,
             resources=resources,
-            scheduling_strategy=opts.get("scheduling_strategy"),
+            scheduling_strategy=to_internal(opts.get("scheduling_strategy")),
             max_retries=opts.get("max_retries"),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             runtime_env=opts.get("runtime_env"),

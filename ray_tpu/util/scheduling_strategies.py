@@ -1,0 +1,56 @@
+"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py
+:15,41,135). The user-facing wrappers convert to the internal TaskSpec
+strategies at submission time (ray_tpu/_private/task_spec.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Union
+
+from ray_tpu._private.task_spec import (
+    DefaultStrategy,
+    NodeAffinityStrategy,
+    PlacementGroupStrategy,
+    SchedulingStrategy,
+    SpreadStrategy,
+)
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin to a node by id (hex). soft=True allows fallback elsewhere."""
+
+    node_id: str
+    soft: bool = False
+
+    def _to_internal(self) -> SchedulingStrategy:
+        return NodeAffinityStrategy(self.node_id, self.soft)
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: "PlacementGroup"
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+    def _to_internal(self) -> SchedulingStrategy:
+        return PlacementGroupStrategy(
+            self.placement_group.id.binary(),
+            self.placement_group_bundle_index,
+            self.placement_group_capture_child_tasks,
+        )
+
+
+def to_internal(strategy) -> Optional[SchedulingStrategy]:
+    """Normalize user-provided strategies: "DEFAULT"/"SPREAD" strings, the
+    wrapper dataclasses above, or an already-internal strategy."""
+    if strategy is None:
+        return None
+    if isinstance(strategy, SchedulingStrategy):
+        return strategy
+    if isinstance(strategy, str):
+        return {"DEFAULT": DefaultStrategy(),
+                "SPREAD": SpreadStrategy()}[strategy]
+    if hasattr(strategy, "_to_internal"):
+        return strategy._to_internal()
+    raise TypeError(f"invalid scheduling strategy {strategy!r}")

@@ -1,0 +1,143 @@
+"""Multi-node tests over cluster_utils (reference analog:
+python/ray/tests/test_multi_node*.py on cluster_utils.Cluster)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    remove_placement_group,
+)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_node_args={"num_cpus": 2, "node_name": "head",
+                                "object_store_memory": 128 * 1024 * 1024})
+    c.add_node(num_cpus=2, node_name="w1",
+               object_store_memory=128 * 1024 * 1024)
+    c.add_node(num_cpus=2, node_name="w2",
+               object_store_memory=128 * 1024 * 1024)
+    c.connect()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_cluster_sees_all_nodes(cluster):
+    nodes = ray_tpu.nodes()
+    assert len([n for n in nodes if n["alive"]]) == 3
+    assert ray_tpu.cluster_resources()["CPU"] == 6.0
+
+
+def test_spread_tasks_use_multiple_nodes(cluster):
+    @ray_tpu.remote
+    def where():
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    refs = [where.options(scheduling_strategy="SPREAD").remote()
+            for _ in range(12)]
+    hosts = set(ray_tpu.get(refs))
+    assert len(hosts) >= 2
+
+
+def test_oversubscribed_tasks_spill_to_other_nodes(cluster):
+    @ray_tpu.remote
+    def hold():
+        time.sleep(0.5)
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    # 6 concurrent 2-CPU... 6 tasks x 1 CPU > 2 local slots: must spill.
+    refs = [hold.remote() for _ in range(6)]
+    hosts = set(ray_tpu.get(refs, timeout=60))
+    assert len(hosts) >= 2
+
+
+def test_node_affinity(cluster):
+    target = [n for n in ray_tpu.nodes()
+              if n["labels"]["node_name"] == "w1"][0]
+
+    @ray_tpu.remote
+    def where():
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    strat = NodeAffinitySchedulingStrategy(node_id=target["node_id"].hex())
+    got = ray_tpu.get(where.options(scheduling_strategy=strat).remote())
+    assert bytes.fromhex(got) == target["node_id"]
+
+
+def test_cross_node_object_fetch(cluster):
+    import numpy as np
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange(500_000, dtype=np.int64)  # > inline threshold
+
+    @ray_tpu.remote
+    def consume(arr):
+        return int(arr.sum())
+
+    strat = {"scheduling_strategy": "SPREAD"}
+    ref = produce.options(**strat).remote()
+    outs = [consume.options(**strat).remote(ref) for _ in range(4)]
+    expected = int(np.arange(500_000, dtype=np.int64).sum())
+    assert ray_tpu.get(outs, timeout=60) == [expected] * 4
+
+
+def test_placement_group_strict_spread(cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+                         strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return os.environ["RAY_TPU_NODE_ID"]
+
+    hosts = ray_tpu.get([
+        where.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=i)).remote()
+        for i in range(3)
+    ], timeout=60)
+    assert len(set(hosts)) == 3
+    remove_placement_group(pg)
+
+
+def test_placement_group_actor(cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @ray_tpu.remote
+    class Pinned:
+        def node(self):
+            return os.environ["RAY_TPU_NODE_ID"]
+
+    a = Pinned.options(
+        num_cpus=1,
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0),
+    ).remote()
+    node_hex = ray_tpu.get(a.node.remote(), timeout=30)
+    pg_info = None
+    w = None
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    pg_info = w.loop_thread.run(
+        w.gcs_client.call("get_placement_group", pg_id=pg.id.binary()))
+    bundle_node = pg_info["bundle_nodes"][0]
+    assert bytes.fromhex(node_hex) == bundle_node
+    ray_tpu.kill(a)
+    remove_placement_group(pg)
+
+
+def test_infeasible_pg_reports_not_ready(cluster):
+    # Stays PENDING (the GCS retries as nodes join); ready() times out False.
+    pg = placement_group([{"CPU": 64}], strategy="PACK")
+    assert not pg.ready(timeout=2)
+    remove_placement_group(pg)
