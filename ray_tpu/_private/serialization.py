@@ -74,7 +74,9 @@ class _Pickler(cloudpickle.Pickler):
             import numpy as np
 
             return (np.asarray, (np.asarray(obj),))
-        return NotImplemented
+        # Delegate to cloudpickle (local functions, lambdas, dynamic classes);
+        # returning NotImplemented here would fall back to plain pickle.
+        return super().reducer_override(obj)
 
 
 class _Unpickler(pickle.Unpickler):

@@ -1,0 +1,19 @@
+from ray_tpu.collective.collective import (
+    CollectiveGroup,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    get_group,
+    init_collective_group,
+)
+
+__all__ = [
+    "CollectiveGroup",
+    "allgather",
+    "allreduce",
+    "barrier",
+    "broadcast",
+    "get_group",
+    "init_collective_group",
+]

@@ -1,0 +1,192 @@
+"""Explicit collectives among actors/tasks (reference:
+python/ray/util/collective/collective.py:150,187,295-692 — NCCL/gloo groups
+with named-actor rendezvous).
+
+TPU-first split (SURVEY §2.8 "TPU-native equivalent"):
+- The HIGH-BANDWIDTH path on TPU is XLA collectives compiled into programs
+  (psum/all_gather over ICI via shard_map/pjit) — see ray_tpu.parallel. This
+  module is the *out-of-program* control-path collective: rendezvous, small
+  tensors, CPU fallback for tests (the reference's cpu_communicator pattern).
+- Backend "store": a named coordinator actor + object store, works anywhere.
+- Backend "jax": rendezvous for jax.distributed.initialize so multi-host
+  SPMD programs can form a global device mesh (coordinator address exchange).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_groups: Dict[str, "CollectiveGroup"] = {}
+
+
+class _Coordinator:
+    """Named rendezvous + reduction actor, one per collective group.
+
+    Each collective round: every rank calls contribute(round_key, rank, value)
+    and polls collect(round_key) until all world_size contributions arrived.
+    Values ride the object store (zero-copy numpy); reduction happens here
+    once and the reduced value is shared by reference.
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.rounds: Dict[str, Dict[int, Any]] = {}
+        self.results: Dict[str, Any] = {}
+        self.ranks_joined: Dict[int, bool] = {}
+
+    def join(self, rank: int) -> int:
+        self.ranks_joined[rank] = True
+        return len(self.ranks_joined)
+
+    def num_joined(self) -> int:
+        return len(self.ranks_joined)
+
+    def contribute(self, round_key: str, rank: int, value: Any,
+                   op: str = "sum") -> None:
+        entries = self.rounds.setdefault(round_key, {})
+        entries[rank] = value
+        if len(entries) == self.world_size and round_key not in self.results:
+            self.results[round_key] = self._reduce(round_key, entries, op)
+            del self.rounds[round_key]
+
+    def _reduce(self, round_key: str, entries: Dict[int, Any], op: str) -> Any:
+        ordered = [entries[r] for r in sorted(entries)]
+        kind = round_key.split(":", 1)[0]
+        if kind == "allgather":
+            return ordered
+        if kind == "broadcast":
+            return next(v for v in ordered if v is not None)
+        if kind == "barrier":
+            return True
+        arrs = [np.asarray(v) for v in ordered]
+        if op == "sum":
+            out = arrs[0].copy()
+            for a in arrs[1:]:
+                out = out + a
+            return out
+        if op == "max":
+            return np.maximum.reduce(arrs)
+        if op == "min":
+            return np.minimum.reduce(arrs)
+        if op == "mean":
+            out = arrs[0].copy()
+            for a in arrs[1:]:
+                out = out + a
+            return out / len(arrs)
+        raise ValueError(f"unknown reduce op {op!r}")
+
+    def collect(self, round_key: str) -> Any:
+        return self.results.get(round_key, _PENDING)
+
+    def gc(self, before_round: str) -> None:
+        for k in [k for k in self.results if k < before_round]:
+            del self.results[k]
+
+
+_PENDING = "__ray_tpu_collective_pending__"
+
+
+class CollectiveGroup:
+    def __init__(self, name: str, world_size: int, rank: int,
+                 coordinator: "ray_tpu.ActorHandle"):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self._coord = coordinator
+        self._round = 0
+
+    def _next_key(self, kind: str) -> str:
+        self._round += 1
+        return f"{kind}:{self._round:012d}"
+
+    def _run_round(self, kind: str, value: Any, op: str = "sum",
+                   timeout: Optional[float] = 300.0) -> Any:
+        key = self._next_key(kind)
+        ray_tpu.get(self._coord.contribute.remote(key, self.rank, value, op))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.001
+        while True:
+            result = ray_tpu.get(self._coord.collect.remote(key))
+            if not (isinstance(result, str) and result == _PENDING):
+                return result
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"collective {kind} round {key} timed out in group "
+                    f"{self.name!r} (rank {self.rank})")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.05)
+
+    # -- API (reference: collective.py allreduce:295, broadcast, allgather,
+    #    barrier, reduce) --
+
+    def allreduce(self, value, op: str = "sum"):
+        return self._run_round("allreduce", value, op)
+
+    def allgather(self, value) -> List[Any]:
+        return self._run_round("allgather", value)
+
+    def broadcast(self, value=None, src_rank: int = 0):
+        send = value if self.rank == src_rank else None
+        return self._run_round("broadcast", send)
+
+    def barrier(self) -> None:
+        self._run_round("barrier", True)
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "store",
+    group_name: str = "default",
+) -> CollectiveGroup:
+    """Join (creating if needed) a named collective group. Every participant
+    calls this with its rank; rendezvous is via a named detached actor
+    (reference: nccl rendezvous via named actor, nccl_collective_group.py:29)."""
+    if backend not in ("store", "jax"):
+        raise ValueError(f"unknown backend {backend!r}")
+    actor_name = f"__collective_{group_name}"
+    coordinator = None
+    Coord = ray_tpu.remote(_Coordinator)
+    try:
+        coordinator = Coord.options(
+            name=actor_name, lifetime="detached").remote(world_size)
+    except ValueError:
+        coordinator = ray_tpu.get_actor(actor_name)
+    ray_tpu.get(coordinator.join.remote(rank))
+    group = CollectiveGroup(group_name, world_size, rank, coordinator)
+    _groups[group_name] = group
+    return group
+
+
+def get_group(group_name: str = "default") -> Optional[CollectiveGroup]:
+    return _groups.get(group_name)
+
+
+def allreduce(value, op: str = "sum", group_name: str = "default"):
+    return _require(group_name).allreduce(value, op)
+
+
+def allgather(value, group_name: str = "default"):
+    return _require(group_name).allgather(value)
+
+
+def broadcast(value=None, src_rank: int = 0, group_name: str = "default"):
+    return _require(group_name).broadcast(value, src_rank)
+
+
+def barrier(group_name: str = "default"):
+    return _require(group_name).barrier()
+
+
+def _require(group_name: str) -> CollectiveGroup:
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"no collective group {group_name!r} in this process; call "
+            "init_collective_group first")
+    return g

@@ -1,0 +1,39 @@
+"""ray_tpu.train — distributed training (reference: python/ray/train).
+
+Layers:
+- step.py: the functional TPU compute core (sharded pjit train steps);
+- trainer.py/worker_group.py: the controller + gang of worker actors;
+- session.py: report()/get_context() inside the training fn;
+- config.py/_checkpoint.py: configs and directory checkpoints.
+"""
+
+from ray_tpu.train._checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import get_context, get_dataset_shard, report
+from ray_tpu.train.trainer import (
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+    TrainingFailedError,
+)
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainingFailedError",
+    "get_context",
+    "get_dataset_shard",
+    "report",
+]

@@ -1,0 +1,97 @@
+"""Checkpoint: a directory handle (reference: train/_checkpoint.py:56 — a
+directory on fsspec/pyarrow storage; here local/NFS paths, orbax-compatible:
+an orbax CheckpointManager directory round-trips through this unchanged)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or os.path.join(tempfile.gettempdir(),
+                                    f"ckpt_{uuid.uuid4().hex[:8]}")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path,))
+
+
+class CheckpointManager:
+    """Keeps top-K checkpoints by score (reference:
+    v2/_internal/execution/checkpoint/checkpoint_manager.py)."""
+
+    def __init__(self, storage_path: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._entries: list = []  # (score, index, path, metrics)
+        self._index = 0
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, source_dir: str,
+                 metrics: Dict[str, Any]) -> Checkpoint:
+        self._index += 1
+        dest = os.path.join(self.storage_path,
+                            f"checkpoint_{self._index:06d}")
+        shutil.copytree(source_dir, dest, dirs_exist_ok=True)
+        score = None
+        if self.score_attribute is not None:
+            score = metrics.get(self.score_attribute)
+        self._entries.append((score, self._index, dest, dict(metrics)))
+        self._evict()
+        return Checkpoint(dest)
+
+    def _evict(self) -> None:
+        if self.num_to_keep is None or len(self._entries) <= self.num_to_keep:
+            return
+        if self.score_attribute is None:
+            ordered = sorted(self._entries, key=lambda e: e[1])  # oldest first
+        else:
+            sign = 1 if self.score_order == "max" else -1
+            ordered = sorted(
+                self._entries,
+                key=lambda e: (sign * e[0] if e[0] is not None else float("-inf")))
+        while len(self._entries) > self.num_to_keep:
+            victim = ordered.pop(0)
+            self._entries.remove(victim)
+            shutil.rmtree(victim[2], ignore_errors=True)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        return Checkpoint(max(self._entries, key=lambda e: e[1])[2])
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self._entries:
+            return None
+        if self.score_attribute is None:
+            return self.latest
+        sign = 1 if self.score_order == "max" else -1
+        scored = [e for e in self._entries if e[0] is not None]
+        if not scored:
+            return self.latest
+        return Checkpoint(max(scored, key=lambda e: sign * e[0])[2])

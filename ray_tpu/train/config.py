@@ -1,0 +1,62 @@
+"""Train configs (reference: python/ray/air/config.py — ScalingConfig,
+RunConfig, FailureConfig, CheckpointConfig)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each needs.
+
+    TPU-first: `use_tpu` + `tpus_per_worker` claim TPU chips; `topology`
+    ("2x2x1" etc.) requests slice-aware gang placement via the TPU head
+    resource (reference tpu.py:110 pod-slice naming)."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float = 0.0
+    cpus_per_worker: float = 1.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    topology: str = ""
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", float(self.cpus_per_worker))
+        if self.use_tpu or self.tpus_per_worker:
+            res["TPU"] = float(self.tpus_per_worker or 1.0)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: worker-group restarts before giving up (-1 = infinite)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+        name = self.name or "run"
+        return os.path.join(base, name)

@@ -1,0 +1,99 @@
+"""Per-worker training session: ray_tpu.train.report / get_context
+(reference: train/v2/api/train_fn_utils.py — report:~, get_context,
+get_dataset_shard:150)."""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+
+class TrainContext:
+    def __init__(self, rank: int, world_size: int, local_rank: int,
+                 node_rank: int, experiment_name: str,
+                 checkpoint: Optional[Checkpoint], dataset_shards=None):
+        self._rank = rank
+        self._world_size = world_size
+        self._local_rank = local_rank
+        self._node_rank = node_rank
+        self._experiment_name = experiment_name
+        self._checkpoint = checkpoint
+        self._dataset_shards = dataset_shards or {}
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def get_world_rank(self) -> int:
+        return self._rank
+
+    def get_local_rank(self) -> int:
+        return self._local_rank
+
+    def get_node_rank(self) -> int:
+        return self._node_rank
+
+    def get_experiment_name(self) -> str:
+        return self._experiment_name
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        """Latest checkpoint on restore (after a failure restart)."""
+        return self._checkpoint
+
+
+class _Session:
+    """Lives in the worker actor while the user train fn runs in a thread."""
+
+    def __init__(self, context: TrainContext):
+        self.context = context
+        self.results: "queue.Queue" = queue.Queue()
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        self.results.put({"metrics": dict(metrics), "checkpoint": checkpoint,
+                          "rank": self.context.get_world_rank()})
+
+
+_session: Optional[_Session] = None
+
+
+def _set_session(s: Optional[_Session]) -> None:
+    global _session
+    _session = s
+
+
+def get_session() -> Optional[_Session]:
+    return _session
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (+ optionally a checkpoint directory) from the training
+    loop. Rank 0's checkpoint is persisted by the controller."""
+    s = _session
+    if s is None:
+        raise RuntimeError("ray_tpu.train.report() called outside a training "
+                           "worker")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = _session
+    if s is None:
+        raise RuntimeError("no training session in this process")
+    return s.context
+
+
+def get_dataset_shard(name: str = "train"):
+    s = _session
+    if s is None:
+        raise RuntimeError("no training session in this process")
+    shard = s.context._dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(f"no dataset shard named {name!r}")
+    return shard

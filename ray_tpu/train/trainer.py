@@ -1,0 +1,173 @@
+"""Data-parallel trainer + controller loop (reference:
+train/v2/api/data_parallel_trainer.py:108 and the TrainController state
+machine, v2/_internal/execution/controller/controller.py:94).
+
+The controller runs driver-side: create the gang -> wire the distributed
+backend -> start the fn -> poll -> persist rank-0 checkpoints -> on worker
+failure, restart the group from the latest checkpoint (FailureConfig), which
+on TPU doubles as the preemption-recovery path (SURVEY §7.3: maintenance
+events surface as worker death)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError, RayTpuError
+from ray_tpu.train._checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.session import TrainContext  # noqa: F401 (re-export)
+from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class TrainingFailedError(RayTpuError):
+    pass
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Checkpoint]
+    best_checkpoint: Optional[Checkpoint]
+    error: Optional[str]
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+
+class DataParallelTrainer:
+    """Run `train_loop_per_worker` on N workers with a shared jax backend.
+
+    TPU-first: backend="jax" initializes jax.distributed across workers so
+    every worker participates in one global SPMD mesh; gradient sync happens
+    inside the jitted step over ICI (see ray_tpu.train.step), NOT through
+    eager allreduce calls."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        backend: str = "jax",
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self.train_fn = train_loop_per_worker
+        self.config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend = backend
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        storage = self.run_config.resolved_storage_path()
+        ckpt_cfg = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            storage,
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order,
+        )
+        max_failures = self.run_config.failure_config.max_failures
+        failures = 0
+        history: List[Dict[str, Any]] = []
+        last_error: Optional[str] = None
+        restore: Optional[Checkpoint] = None
+
+        while True:
+            group = self._start_group(restore)
+            try:
+                error = self._poll_until_done(group, manager, history)
+            finally:
+                group.shutdown()
+            if error is None:
+                return Result(
+                    metrics=history[-1] if history else None,
+                    checkpoint=manager.latest,
+                    best_checkpoint=manager.best,
+                    error=None,
+                    metrics_history=history,
+                )
+            last_error = error
+            failures += 1
+            if max_failures >= 0 and failures > max_failures:
+                raise TrainingFailedError(
+                    f"training failed after {failures - 1} restarts: {error}")
+            restore = manager.latest
+            logger.warning("training attempt failed (%s); restarting from %s",
+                           error, restore)
+
+    # ------------------------------------------------------------------
+    def _start_group(self, restore: Optional[Checkpoint]) -> WorkerGroup:
+        name = self.run_config.name or self.train_fn.__name__
+        group = WorkerGroup(
+            num_workers=self.scaling.num_workers,
+            resources_per_worker=self.scaling.worker_resources(),
+            placement_strategy=self.scaling.placement_strategy,
+            experiment_name=name,
+        )
+        backend_config: Dict[str, Any] = {"kind": self.backend}
+        if self.backend == "jax" and self.scaling.num_workers > 1:
+            from ray_tpu._private.node import free_port
+
+            ip = ray_tpu.get(group.workers[0].node_ip.remote(), timeout=30)
+            backend_config["coordinator"] = f"{ip}:{free_port()}"
+        group.setup_backend(backend_config)
+        shards = self._dataset_shards()
+        group.start_training(self.train_fn, self.config, restore, shards)
+        return group
+
+    def _dataset_shards(self):
+        if not self.datasets:
+            return None
+        n = self.scaling.num_workers
+        per_worker: List[Dict[str, Any]] = [{} for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                splits = ds.streaming_split(n)
+                for i in range(n):
+                    per_worker[i][name] = splits[i]
+            else:
+                for i in range(n):
+                    per_worker[i][name] = ds
+        return per_worker
+
+    def _poll_until_done(self, group: WorkerGroup,
+                         manager: CheckpointManager,
+                         history: List[Dict[str, Any]]) -> Optional[str]:
+        """Returns None on success, an error string on worker failure."""
+        while True:
+            try:
+                polls = group.poll()
+            except (RayActorError, ray_tpu.ActorDiedError,
+                    ray_tpu.ActorUnavailableError,
+                    ray_tpu.GetTimeoutError) as e:
+                return f"worker died: {e}"
+            rank0_results = []
+            for p in polls:
+                for item in p["results"]:
+                    if item["rank"] == 0:
+                        rank0_results.append(item)
+            for item in rank0_results:
+                metrics = item["metrics"]
+                history.append(metrics)
+                ckpt = item.get("checkpoint")
+                if ckpt is not None:
+                    manager.register(ckpt.path, metrics)
+            errors = [p["error"] for p in polls if p["error"]]
+            if errors:
+                tb = next((p.get("traceback") for p in polls if p["error"]), "")
+                return f"{errors[0]}\n{tb}"
+            if all(p["finished"] for p in polls):
+                return None
+            time.sleep(0.05)
+
+
+# The reference exposes framework-specific trainers (TorchTrainer); the
+# native TPU analog is a thin alias.
+JaxTrainer = DataParallelTrainer

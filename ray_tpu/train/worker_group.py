@@ -1,0 +1,175 @@
+"""Training worker group: N gang-scheduled actors (reference:
+train/v2/_internal/execution/worker_group/worker_group.py:105 + the poll loop
+in worker_group/poll.py).
+
+TPU-first: each worker is one *host process* that runs SPMD programs over its
+local chips; the JaxBackend wires jax.distributed so multi-host meshes form
+over ICI/DCN (reference's _TorchBackend NCCL rendezvous analog,
+train/torch/config.py:153)."""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.session import TrainContext, _Session, _set_session
+from ray_tpu.util import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+class _TrainWorker:
+    """Actor hosting one training process. The user fn runs on a thread;
+    the actor's async side polls reported results (reference:
+    worker_group/thread_runner.py)."""
+
+    def __init__(self, rank: int, world_size: int, local_rank: int,
+                 node_rank: int, experiment_name: str,
+                 env_vars: Optional[Dict[str, str]] = None):
+        self.rank = rank
+        self.world_size = world_size
+        for k, v in (env_vars or {}).items():
+            os.environ[k] = v
+        os.environ["RAY_TRAIN_RANK"] = str(rank)
+        os.environ["RAY_TRAIN_WORLD_SIZE"] = str(world_size)
+        self._context_args = (rank, world_size, local_rank, node_rank,
+                              experiment_name)
+        self._session: Optional[_Session] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def node_ip(self) -> str:
+        return "127.0.0.1"
+
+    def node_id(self) -> str:
+        return os.environ.get("RAY_TPU_NODE_ID", "")
+
+    def setup_backend(self, backend_config: Dict[str, Any]) -> None:
+        """Initialize the distributed compute plane (jax.distributed) before
+        the training fn starts."""
+        if backend_config.get("kind") != "jax":
+            return
+        if self.world_size <= 1 or not backend_config.get("coordinator"):
+            return
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=backend_config["coordinator"],
+            num_processes=self.world_size,
+            process_id=self.rank,
+        )
+
+    def start_training(self, train_fn_ref, config: Dict[str, Any],
+                       checkpoint: Optional[Checkpoint],
+                       dataset_shards: Optional[Dict[str, Any]] = None) -> None:
+        train_fn = train_fn_ref
+        ctx = TrainContext(*self._context_args, checkpoint=checkpoint,
+                           dataset_shards=dataset_shards)
+        self._session = _Session(ctx)
+        _set_session(self._session)
+
+        def run():
+            try:
+                if _takes_arg(train_fn):
+                    train_fn(config)
+                else:
+                    train_fn()
+            except BaseException as e:  # noqa: BLE001
+                self._session.error = e
+                self._session.error_tb = traceback.format_exc()
+            finally:
+                self._session.finished.set()
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="train_fn")
+        self._thread.start()
+
+    def poll(self) -> Dict[str, Any]:
+        """Drain reported results; say whether the fn finished/errored."""
+        s = self._session
+        out: List[Dict[str, Any]] = []
+        while True:
+            try:
+                out.append(s.results.get_nowait())
+            except Exception:
+                break
+        reply: Dict[str, Any] = {
+            "results": out,
+            "finished": s.finished.is_set(),
+            "error": None,
+        }
+        if s.error is not None:
+            reply["error"] = f"{type(s.error).__name__}: {s.error}"
+            reply["traceback"] = getattr(s, "error_tb", "")
+        return reply
+
+
+def _takes_arg(fn: Callable) -> bool:
+    import inspect
+
+    try:
+        return len(inspect.signature(fn).parameters) > 0
+    except (TypeError, ValueError):
+        return False
+
+
+class WorkerGroup:
+    """Creates/destroys the gang of _TrainWorker actors on a placement
+    group."""
+
+    def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
+                 placement_strategy: str, experiment_name: str,
+                 env_vars: Optional[Dict[str, str]] = None):
+        self.num_workers = num_workers
+        self.experiment_name = experiment_name
+        bundles = [dict(resources_per_worker) for _ in range(num_workers)]
+        self.pg = placement_group(bundles, strategy=placement_strategy)
+        if not self.pg.ready(timeout=120):
+            remove_placement_group(self.pg)
+            raise RuntimeError(
+                f"placement group for {num_workers} x {resources_per_worker} "
+                "could not be scheduled")
+        WorkerActor = ray_tpu.remote(_TrainWorker)
+        self.workers = []
+        for rank in range(num_workers):
+            self.workers.append(
+                WorkerActor.options(
+                    num_cpus=resources_per_worker.get("CPU", 1.0),
+                    num_tpus=resources_per_worker.get("TPU", 0.0) or None,
+                    scheduling_strategy=PlacementGroupSchedulingStrategy(
+                        placement_group=self.pg,
+                        placement_group_bundle_index=rank),
+                ).remote(rank, num_workers, local_rank=0, node_rank=rank,
+                         experiment_name=experiment_name, env_vars=env_vars))
+
+    def setup_backend(self, backend_config: Dict[str, Any]) -> None:
+        ray_tpu.get([w.setup_backend.remote(backend_config)
+                     for w in self.workers], timeout=120)
+
+    def start_training(self, train_fn, config, checkpoint,
+                       dataset_shards_per_worker=None) -> None:
+        refs = []
+        for i, w in enumerate(self.workers):
+            shards = (dataset_shards_per_worker[i]
+                      if dataset_shards_per_worker else None)
+            refs.append(w.start_training.remote(train_fn, config, checkpoint,
+                                                shards))
+        ray_tpu.get(refs, timeout=120)
+
+    def poll(self) -> List[Dict[str, Any]]:
+        return ray_tpu.get([w.poll.remote() for w in self.workers],
+                           timeout=60)
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self.pg)
+        except Exception:
+            pass
+        self.workers = []

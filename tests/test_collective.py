@@ -1,0 +1,68 @@
+"""Collective API tests (reference: python/ray/util/collective/tests — here
+against the store backend, the CPU-fallback communicator)."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.collective import collective as col
+
+
+def test_allreduce_among_actors(ray_start_regular):
+    @ray_tpu.remote
+    class Member:
+        def __init__(self, rank, n):
+            self.group = col.init_collective_group(n, rank,
+                                                  group_name="ar_test")
+            self.rank = rank
+
+        def run(self):
+            out = self.group.allreduce(np.full(8, self.rank + 1.0))
+            return out
+
+    n = 3
+    members = [Member.remote(i, n) for i in range(n)]
+    outs = ray_tpu.get([m.run.remote() for m in members], timeout=60)
+    expected = np.full(8, sum(range(1, n + 1)), dtype=float)
+    for out in outs:
+        np.testing.assert_array_equal(out, expected)
+
+
+def test_allgather_and_broadcast(ray_start_regular):
+    @ray_tpu.remote
+    class Member:
+        def __init__(self, rank, n):
+            self.group = col.init_collective_group(n, rank,
+                                                  group_name="ag_test")
+            self.rank = rank
+
+        def gather(self):
+            return self.group.allgather(self.rank * 10)
+
+        def bcast(self):
+            return self.group.broadcast(
+                value="from-zero" if self.rank == 0 else None, src_rank=0)
+
+    n = 3
+    members = [Member.remote(i, n) for i in range(n)]
+    gathered = ray_tpu.get([m.gather.remote() for m in members], timeout=60)
+    assert gathered == [[0, 10, 20]] * n
+    assert ray_tpu.get([m.bcast.remote() for m in members],
+                       timeout=60) == ["from-zero"] * n
+
+
+def test_barrier_and_mean(ray_start_regular):
+    @ray_tpu.remote
+    class Member:
+        def __init__(self, rank, n):
+            self.group = col.init_collective_group(n, rank,
+                                                  group_name="bar_test")
+            self.rank = rank
+
+        def run(self):
+            self.group.barrier()
+            return float(self.group.allreduce(
+                np.array([self.rank], dtype=float), op="mean")[0])
+
+    members = [Member.remote(i, 2) for i in range(2)]
+    assert ray_tpu.get([m.run.remote() for m in members],
+                       timeout=60) == [0.5, 0.5]
