@@ -40,6 +40,15 @@ class SerializedObject:
     def total_bytes(self) -> int:
         return sum(len(b) for b in self.buffers) + len(self.metadata)
 
+    def __reduce__(self):
+        # A SerializedObject may itself be re-pickled — inline task args
+        # embedded in a TaskSpec, or inline results in an RPC reply. Its oob
+        # buffers are zero-copy memoryviews, which plain pickle rejects;
+        # wrap them as PickleBuffers so protocol-5 picklers (the RPC frame
+        # layer) ship them out-of-band, still zero-copy.
+        return (SerializedObject,
+                (self.metadata, wire_buffers(self.buffers), self.nested_refs))
+
 
 # ObjectRef is defined in object_ref.py; typed loosely here to avoid a cycle.
 ObjectRefLike = Any
@@ -47,6 +56,14 @@ ObjectRefLike = Any
 METADATA_PICKLE = b"py"
 METADATA_ERROR = b"err"
 METADATA_RAW = b"raw"
+
+
+def wire_buffers(buffers: List[Any]) -> List[Any]:
+    """Prepare a buffer list for embedding in a pickled RPC message: bytes
+    pass through; memoryviews become PickleBuffers (out-of-band under
+    protocol 5 with a buffer_callback, in-band otherwise — never an error)."""
+    return [b if isinstance(b, bytes) else pickle.PickleBuffer(b)
+            for b in buffers]
 
 
 def _is_jax_array(value: Any) -> bool:

@@ -823,6 +823,13 @@ class Worker:
                 self.task_manager.fail_permanently(
                     spec.task_id, ser.serialize_error(err))
             return not isinstance(e, (ConnectionLost, OSError))
+        except Exception as e:
+            # Unexpected local failure (e.g. a spec that won't serialize must
+            # fail the task, not strand it forever in PENDING).
+            logger.exception("push_task failed locally for %s", spec.task_id)
+            self.task_manager.fail_permanently(
+                spec.task_id, ser.serialize_error(e))
+            return True
         await self.handle_task_reply(spec, reply)
         return True
 
@@ -868,6 +875,7 @@ class Worker:
         detached: bool = False,
         runtime_env: Optional[Dict[str, Any]] = None,
         scheduling_strategy: Any = None,
+        get_if_exists: bool = False,
     ) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         cls_key = self.function_manager.export(cls, self.job_id.hex())
@@ -898,10 +906,13 @@ class Worker:
                 name=name,
                 max_restarts=max_restarts,
                 detached=detached,
+                get_if_exists=get_if_exists,
             )
         )
         if not reply.get("ok"):
             raise ValueError(reply.get("error", "actor registration failed"))
+        if reply.get("existing_actor_id"):
+            return ActorID(reply["existing_actor_id"])
         return actor_id
 
     def submit_actor_task(
@@ -1091,7 +1102,8 @@ class Worker:
                 self.shm.put_serialized(oid, obj)
                 out.append(("shm", self.node_id.binary()))
             else:
-                out.append(("inline", obj.metadata, obj.buffers))
+                out.append(("inline", obj.metadata,
+                            ser.wire_buffers(obj.buffers)))
         return out
 
     def _error_result(self, exc: BaseException) -> Tuple:
@@ -1120,7 +1132,7 @@ class Worker:
         if isinstance(entry, ShmMarker):
             return {"kind": "shm", "node_id": entry.node_id}
         return {"kind": "inline", "metadata": entry.metadata,
-                "buffers": entry.buffers}
+                "buffers": ser.wire_buffers(entry.buffers)}
 
     async def _rpc_wait_object(self, object_id: bytes,
                                timeout: float = 30.0) -> bool:

@@ -95,6 +95,8 @@ class ActorClass:
         if num_tpus:
             resources["TPU"] = float(num_tpus)
         lifetime = opts.get("lifetime")
+        if opts.get("get_if_exists") and not opts.get("name"):
+            raise ValueError("get_if_exists=True requires a `name`")
         from ray_tpu.util.scheduling_strategies import to_internal
 
         actor_id = w.create_actor(
@@ -109,6 +111,7 @@ class ActorClass:
             detached=(lifetime == "detached"),
             runtime_env=opts.get("runtime_env"),
             scheduling_strategy=to_internal(opts.get("scheduling_strategy")),
+            get_if_exists=bool(opts.get("get_if_exists", False)),
         )
         return ActorHandle(
             actor_id,

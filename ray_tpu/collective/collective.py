@@ -150,13 +150,14 @@ def init_collective_group(
     if backend not in ("store", "jax"):
         raise ValueError(f"unknown backend {backend!r}")
     actor_name = f"__collective_{group_name}"
-    coordinator = None
     Coord = ray_tpu.remote(_Coordinator)
-    try:
-        coordinator = Coord.options(
-            name=actor_name, lifetime="detached").remote(world_size)
-    except ValueError:
-        coordinator = ray_tpu.get_actor(actor_name)
+    # Atomic get-or-create: concurrent joiners race to create the named
+    # coordinator; the GCS resolves the race and hands losers the winner's
+    # handle (reference: nccl rendezvous via named actor,
+    # nccl_collective_group.py:29, with get_if_exists).
+    coordinator = Coord.options(
+        name=actor_name, lifetime="detached",
+        get_if_exists=True).remote(world_size)
     ray_tpu.get(coordinator.join.remote(rank))
     group = CollectiveGroup(group_name, world_size, rank, coordinator)
     _groups[group_name] = group

@@ -358,10 +358,22 @@ class GcsServer:
     async def rpc_register_actor(
         self, actor_id: bytes, creation_spec: bytes, name: str = "",
         max_restarts: int = 0, detached: bool = False,
+        get_if_exists: bool = False,
     ) -> Dict[str, Any]:
         aid = ActorID(actor_id)
+        # Idempotent: a retried registration (client call_retrying after an
+        # RPC blip) must not double-schedule or steal its own name
+        # (reference: gcs_actor_manager.cc RegisterActor dedup).
+        if aid in self.actors:
+            return {"ok": True}
         if name:
-            if name in self.named_actors:
+            existing = self.named_actors.get(name)
+            if existing is not None and existing != aid:
+                if get_if_exists:
+                    # Atomic get-or-create (reference: actor.py
+                    # get_if_exists option → GetOrCreate in GCS).
+                    return {"ok": True,
+                            "existing_actor_id": existing.binary()}
                 return {"ok": False,
                         "error": f"actor name {name!r} already taken"}
             self.named_actors[name] = aid

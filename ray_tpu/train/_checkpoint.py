@@ -51,11 +51,14 @@ class CheckpointManager:
         os.makedirs(storage_path, exist_ok=True)
 
     def register(self, source_dir: str,
-                 metrics: Dict[str, Any]) -> Checkpoint:
+                 metrics: Dict[str, Any], move: bool = False) -> Checkpoint:
         self._index += 1
         dest = os.path.join(self.storage_path,
                             f"checkpoint_{self._index:06d}")
-        shutil.copytree(source_dir, dest, dirs_exist_ok=True)
+        if move:
+            shutil.move(source_dir, dest)
+        else:
+            shutil.copytree(source_dir, dest, dirs_exist_ok=True)
         score = None
         if self.score_attribute is not None:
             score = metrics.get(self.score_attribute)

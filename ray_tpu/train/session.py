@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import os
 import queue
+import tempfile
 import threading
 from typing import Any, Dict, Optional
 
@@ -47,16 +48,39 @@ class TrainContext:
 class _Session:
     """Lives in the worker actor while the user train fn runs in a thread."""
 
-    def __init__(self, context: TrainContext):
+    def __init__(self, context: TrainContext,
+                 staging_dir: Optional[str] = None):
         self.context = context
+        self.staging_dir = staging_dir
         self.results: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
+        # Persist the checkpoint BEFORE returning (reference semantics:
+        # train.report uploads to storage synchronously), so the caller may
+        # delete its local checkpoint dir immediately after report().
+        # Only rank 0's checkpoint is persisted by the controller.
+        if checkpoint is not None:
+            if self.context.get_world_rank() == 0:
+                checkpoint = self._persist(checkpoint)
+            else:
+                checkpoint = None
         self.results.put({"metrics": dict(metrics), "checkpoint": checkpoint,
                           "rank": self.context.get_world_rank()})
+
+    def _persist(self, checkpoint: Checkpoint) -> Checkpoint:
+        import shutil
+        import uuid
+
+        base = self.staging_dir
+        if base is None:
+            base = os.path.join(tempfile.gettempdir(), "ray_tpu_ckpt_staging")
+        os.makedirs(base, exist_ok=True)
+        staged = os.path.join(base, f"staged_{uuid.uuid4().hex[:12]}")
+        shutil.copytree(checkpoint.path, staged)
+        return Checkpoint(staged)
 
 
 _session: Optional[_Session] = None

@@ -11,6 +11,8 @@ events surface as worker death)."""
 from __future__ import annotations
 
 import dataclasses
+import os
+import shutil
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -119,7 +121,13 @@ class DataParallelTrainer:
             backend_config["coordinator"] = f"{ip}:{free_port()}"
         group.setup_backend(backend_config)
         shards = self._dataset_shards()
-        group.start_training(self.train_fn, self.config, restore, shards)
+        # Fresh staging area per attempt: undrained staged checkpoints from a
+        # failed attempt would otherwise accumulate forever.
+        staging = os.path.join(self.run_config.resolved_storage_path(),
+                               ".staging")
+        shutil.rmtree(staging, ignore_errors=True)
+        group.start_training(self.train_fn, self.config, restore, shards,
+                             staging_dir=staging)
         return group
 
     def _dataset_shards(self):
@@ -158,7 +166,8 @@ class DataParallelTrainer:
                 history.append(metrics)
                 ckpt = item.get("checkpoint")
                 if ckpt is not None:
-                    manager.register(ckpt.path, metrics)
+                    # Staged by the worker's report(); we own it — move.
+                    manager.register(ckpt.path, metrics, move=True)
             errors = [p["error"] for p in polls if p["error"]]
             if errors:
                 tb = next((p.get("traceback") for p in polls if p["error"]), "")

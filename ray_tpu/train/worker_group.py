@@ -63,11 +63,12 @@ class _TrainWorker:
 
     def start_training(self, train_fn_ref, config: Dict[str, Any],
                        checkpoint: Optional[Checkpoint],
-                       dataset_shards: Optional[Dict[str, Any]] = None) -> None:
+                       dataset_shards: Optional[Dict[str, Any]] = None,
+                       staging_dir: Optional[str] = None) -> None:
         train_fn = train_fn_ref
         ctx = TrainContext(*self._context_args, checkpoint=checkpoint,
                            dataset_shards=dataset_shards)
-        self._session = _Session(ctx)
+        self._session = _Session(ctx, staging_dir=staging_dir)
         _set_session(self._session)
 
         def run():
@@ -149,13 +150,14 @@ class WorkerGroup:
                      for w in self.workers], timeout=120)
 
     def start_training(self, train_fn, config, checkpoint,
-                       dataset_shards_per_worker=None) -> None:
+                       dataset_shards_per_worker=None,
+                       staging_dir=None) -> None:
         refs = []
         for i, w in enumerate(self.workers):
             shards = (dataset_shards_per_worker[i]
                       if dataset_shards_per_worker else None)
             refs.append(w.start_training.remote(train_fn, config, checkpoint,
-                                                shards))
+                                                shards, staging_dir))
         ray_tpu.get(refs, timeout=120)
 
     def poll(self) -> List[Dict[str, Any]]:

@@ -151,7 +151,7 @@ def test_dp_training_with_collective_sync(ray_start_regular):
         shard_x = np.array_split(X, n)[rank]
         shard_y = np.array_split(y, n)[rank]
         w = np.zeros(4)
-        for _ in range(30):
+        for _ in range(60):
             pred = shard_x @ w
             grad = 2 * shard_x.T @ (pred - shard_y) / len(shard_y)
             grad = group.allreduce(grad, op="mean")
