@@ -38,6 +38,53 @@ def _wait_port(host: str, port: int, timeout: float = 30.0) -> None:
     raise TimeoutError(f"service at {host}:{port} did not come up")
 
 
+_signal_nodes: List["Node"] = []
+_signals_installed = False
+
+
+def _register_signal_cleanup(node: "Node") -> None:
+    """atexit does not run on SIGTERM/SIGINT-by-default, which leaks the
+    daemon tree and its prefaulted shm arena. Install chaining handlers that
+    shut nodes down, then re-deliver the signal (only in the main thread of
+    the main interpreter; never overrides an application's own handler
+    beyond chaining to it)."""
+    global _signals_installed
+    _signal_nodes.append(node)
+    if _signals_installed:
+        return
+    import signal
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        return
+
+    def _make(prev):
+        def _handler(signum, frame):
+            for n in list(_signal_nodes):
+                try:
+                    n.shutdown()
+                except Exception:
+                    pass
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+        return _handler
+
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev = signal.getsignal(sig)
+            if prev is signal.SIG_IGN:
+                continue
+            signal.signal(sig, _make(None if prev in (signal.SIG_DFL, None)
+                                     else prev))
+        _signals_installed = True
+    except (ValueError, OSError):  # non-main thread or restricted env
+        pass
+
+
 class Node:
     """Starts a head node's processes (GCS + one nodelet) as subprocesses and
     tears them down at exit."""
@@ -94,6 +141,7 @@ class Node:
         _wait_port(*self.nodelet_address)
         self.store_path = self._wait_store_path()
         atexit.register(self.shutdown)
+        _register_signal_cleanup(self)
 
     def _start_process(self, cmd: List[str], name: str) -> subprocess.Popen:
         log = open(os.path.join(self.session_dir, "logs", f"{name}.log"), "wb")
@@ -128,7 +176,9 @@ class Node:
         for proc in reversed(self.processes):
             if proc.poll() is None:
                 proc.terminate()
-        deadline = time.monotonic() + 3
+        # Grace must cover the nodelet's bounded teardown (worker reap +
+        # server close + arena unlink) before escalating to SIGKILL.
+        deadline = time.monotonic() + 10
         for proc in self.processes:
             try:
                 proc.wait(timeout=max(0.1, deadline - time.monotonic()))

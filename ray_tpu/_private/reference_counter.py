@@ -17,13 +17,15 @@ from ray_tpu._private.ids import ObjectID
 
 
 class _Record:
-    __slots__ = ("local", "owned", "borrowers", "pinned_in_shm")
+    __slots__ = ("local", "owned", "borrowers", "pinned_in_shm",
+                 "owner_address")
 
     def __init__(self, owned: bool):
         self.local = 0
         self.owned = owned
         self.borrowers: Set[Tuple[str, int]] = set()
         self.pinned_in_shm = False
+        self.owner_address: Optional[Tuple[str, int]] = None
 
 
 class ReferenceCounter:
@@ -31,8 +33,11 @@ class ReferenceCounter:
         self._records: Dict[ObjectID, _Record] = {}
         self._lock = threading.Lock()
         self._on_zero = on_zero
-        # Borrows we hold that must be reported to remote owners.
-        self._pending_borrow_reports: Dict[Tuple[str, int], Set[ObjectID]] = {}
+        # Ordered add/remove borrow reports per remote owner. Order matters:
+        # a remove followed by a re-borrow's add must reach the owner in that
+        # sequence or the owner could free under a live borrower.
+        self._pending_borrow_reports: Dict[Tuple[str, int],
+                                           list] = {}
 
     def add_owned_ref(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -51,7 +56,9 @@ class ReferenceCounter:
             rec.local += 1
             if ref.owner_address is not None:
                 addr = tuple(ref.owner_address)
-                self._pending_borrow_reports.setdefault(addr, set()).add(ref.id)
+                rec.owner_address = addr
+                self._pending_borrow_reports.setdefault(addr, []).append(
+                    ("add", ref.id))
 
     def add_borrower(self, object_id: ObjectID, borrower: Tuple[str, int]) -> None:
         """Owner side: a remote worker now holds a reference."""
@@ -82,15 +89,45 @@ class ReferenceCounter:
                     fire = True
                     del self._records[object_id]
                 elif not rec.owned:
+                    if rec.owner_address is not None:
+                        # Last local ref to a borrowed object: tell the owner
+                        # (the half of the protocol that was missing — the
+                        # owner-side handler existed with zero callers).
+                        self._pending_borrow_reports.setdefault(
+                            rec.owner_address, []).append(
+                                ("remove", object_id))
                     del self._records[object_id]
         if fire and self._on_zero:
             self._on_zero(object_id)
 
-    def drain_borrow_reports(self) -> Dict[Tuple[str, int], Set[ObjectID]]:
+    def drain_borrow_reports(self) -> Dict[Tuple[str, int], list]:
         with self._lock:
             out = self._pending_borrow_reports
             self._pending_borrow_reports = {}
             return out
+
+    def requeue_borrow_reports(self, owner: Tuple[str, int],
+                               ops: list) -> None:
+        """Put back a batch whose send failed, ahead of anything queued since
+        (order is part of the protocol)."""
+        with self._lock:
+            existing = self._pending_borrow_reports.get(owner, [])
+            self._pending_borrow_reports[owner] = list(ops) + existing
+
+    def holds_local_ref(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            rec = self._records.get(object_id)
+            return rec is not None and rec.local > 0
+
+    def borrower_snapshot(self) -> Dict[Tuple[str, int], Set[ObjectID]]:
+        """Owner side: current borrowers per address (for the audit loop)."""
+        out: Dict[Tuple[str, int], Set[ObjectID]] = {}
+        with self._lock:
+            for oid, rec in self._records.items():
+                if rec.owned:
+                    for b in rec.borrowers:
+                        out.setdefault(b, set()).add(oid)
+        return out
 
     def num_records(self) -> int:
         with self._lock:

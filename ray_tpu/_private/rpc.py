@@ -149,7 +149,10 @@ class RpcServer:
         if self._server is not None:
             self._server.close()
             try:
-                await self._server.wait_closed()
+                # Python 3.12's wait_closed blocks until every client
+                # connection handler finishes — peers with persistent
+                # connections would stall shutdown forever; bound it.
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
             except Exception:  # pragma: no cover - teardown best effort
                 pass
 

@@ -458,6 +458,7 @@ class Worker:
             await self.gcs_client.connect()
             await self.nodelet_client.connect()
             asyncio.ensure_future(self._borrow_report_loop())
+            asyncio.ensure_future(self._borrower_audit_loop())
 
         self.loop_thread.run(_setup())
         self.connected = True
@@ -485,6 +486,12 @@ class Worker:
         self._shutdown = True
 
         async def _teardown():
+            try:
+                # Graceful exit releases our borrows immediately instead of
+                # waiting for the owner's audit to notice we're gone.
+                await asyncio.wait_for(self._flush_borrow_reports(), 2)
+            except Exception:
+                pass
             if self.gcs_client:
                 await self.gcs_client.close()
             if self.nodelet_client:
@@ -510,8 +517,8 @@ class Worker:
         s.register("push_actor_task_batch", self._rpc_push_actor_task_batch)
         s.register("get_object", self._rpc_get_object)
         s.register("wait_object", self._rpc_wait_object)
-        s.register("add_borrows", self._rpc_add_borrows)
-        s.register("remove_borrows", self._rpc_remove_borrows)
+        s.register("update_borrows", self._rpc_update_borrows)
+        s.register("check_borrows", self._rpc_check_borrows)
         s.register("free_objects", self._rpc_free_objects)
         s.register("cancel_task", self._rpc_cancel_task)
         s.register("exit_worker", self._rpc_exit_worker)
@@ -834,6 +841,38 @@ class Worker:
         return True
 
     async def handle_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
+        # Synchronous borrow handoff (reference: task replies carry borrowed_refs
+        # so the owner registers the executor as borrower BEFORE dropping the
+        # spec's arg pins — closes the free-vs-late-add race). If we are not
+        # the owner of a ref we passed along (we borrowed it ourselves),
+        # forward the registration to the true owner on the executor's behalf.
+        if reply.get("borrows"):
+            b = tuple(reply["borrower"])
+            if b != self.address:
+                owners: Dict[ObjectID, Any] = {}
+                for a in list(spec.args) + list(spec.kwargs.values()):
+                    if a[0] == "ref":
+                        owners[a[1].id] = a[1].owner_address
+                    else:
+                        for r in getattr(a[1], "nested_refs", None) or []:
+                            owners[r.id] = r.owner_address
+                forward: Dict[Tuple[str, int], List[bytes]] = {}
+                for ob in reply["borrows"]:
+                    oid = ObjectID(ob)
+                    owner = owners.get(oid)
+                    if owner is None or tuple(owner) == self.address:
+                        self.ref_counter.add_borrower(oid, b)
+                    else:
+                        forward.setdefault(tuple(owner), []).append(ob)
+                for owner, obs in forward.items():
+                    try:
+                        client = RpcClient(*owner, name="borrow-forward")
+                        await client.notify(
+                            "update_borrows", borrower=list(b),
+                            ops=[("add", ob) for ob in obs])
+                        await client.close()
+                    except Exception:
+                        pass  # executor's own 1s add report is the fallback
         if reply.get("cancelled"):
             self.task_manager.fail_permanently(
                 spec.task_id,
@@ -1023,7 +1062,8 @@ class Worker:
             try:
                 self._current_task_id = task_spec.task_id
                 result = await method(*args, **kwargs)
-                return {"results": self._pack_results(task_spec, result)}
+                return self._with_borrows(task_spec, {
+                    "results": self._pack_results(task_spec, result)})
             except BaseException as e:  # noqa: BLE001
                 return {"results": [self._error_result(e)] *
                         max(1, task_spec.num_returns)}
@@ -1040,7 +1080,8 @@ class Worker:
             args, kwargs = self._resolve_spec_args_sync(spec)
             self._current_task_id = spec.task_id
             result = method(*args, **kwargs)
-            return {"results": self._pack_results(spec, result)}
+            return self._with_borrows(spec, {
+                "results": self._pack_results(spec, result)})
         except BaseException as e:  # noqa: BLE001
             return {"results": [self._error_result(e)] * max(1, spec.num_returns)}
         finally:
@@ -1055,12 +1096,35 @@ class Worker:
             args, kwargs = self._resolve_spec_args_sync(spec)
             self._current_task_id = spec.task_id
             result = fn(*args, **kwargs)
-            return {"results": self._pack_results(spec, result)}
+            return self._with_borrows(spec, {
+                "results": self._pack_results(spec, result)})
         except BaseException as e:  # noqa: BLE001
             logger.info("task %s raised: %r", spec.function_name, e)
             return {"results": [self._error_result(e)] * max(1, spec.num_returns)}
         finally:
             self._current_task_id = None
+
+    def _spec_arg_ref_ids(self, spec: TaskSpec) -> List[ObjectID]:
+        """ObjectIDs referenced by this task's args (direct ref args and
+        refs nested inside value args)."""
+        out: List[ObjectID] = []
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a[0] == "ref":
+                out.append(a[1].id)
+            else:
+                for r in getattr(a[1], "nested_refs", None) or []:
+                    out.append(r.id)
+        return out
+
+    def _with_borrows(self, spec: TaskSpec, reply: Dict[str, Any]) -> Dict[str, Any]:
+        """Attach this executor's arg-ref borrows to a task reply. The owner
+        registers them synchronously; if the user code did not actually keep
+        the refs, our report loop sends the remove once the spec is dropped."""
+        ids = self._spec_arg_ref_ids(spec)
+        if ids:
+            reply["borrows"] = [o.binary() for o in ids]
+            reply["borrower"] = self.address
+        return reply
 
     def _resolve_spec_args_sync(self, spec: TaskSpec) -> Tuple[list, dict]:
         # Fast path: no ref args → pure deserialization, skip the loop hop.
@@ -1143,15 +1207,21 @@ class Worker:
         except asyncio.TimeoutError:
             return self.shm.contains(oid)
 
-    async def _rpc_add_borrows(self, borrower: Tuple[str, int],
-                               object_ids: List[bytes]) -> None:
-        for ob in object_ids:
-            self.ref_counter.add_borrower(ObjectID(ob), tuple(borrower))
+    async def _rpc_update_borrows(self, borrower: Tuple[str, int],
+                                  ops: List[Tuple[str, bytes]]) -> None:
+        """Ordered add/remove batch from one borrower (order preserves
+        remove-then-readd sequences)."""
+        b = tuple(borrower)
+        for op, ob in ops:
+            if op == "add":
+                self.ref_counter.add_borrower(ObjectID(ob), b)
+            else:
+                self.ref_counter.remove_borrower(ObjectID(ob), b)
 
-    async def _rpc_remove_borrows(self, borrower: Tuple[str, int],
-                                  object_ids: List[bytes]) -> None:
-        for ob in object_ids:
-            self.ref_counter.remove_borrower(ObjectID(ob), tuple(borrower))
+    async def _rpc_check_borrows(self, object_ids: List[bytes]) -> List[bytes]:
+        """Audit reply: which of these objects do we still hold refs to."""
+        return [ob for ob in object_ids
+                if self.ref_counter.holds_local_ref(ObjectID(ob))]
 
     async def _rpc_free_objects(self, object_ids: List[bytes]) -> None:
         for ob in object_ids:
@@ -1202,15 +1272,59 @@ class Worker:
     async def _borrow_report_loop(self) -> None:
         while not self._shutdown:
             await asyncio.sleep(1.0)
-            reports = self.ref_counter.drain_borrow_reports()
-            for owner, oids in reports.items():
-                if owner == self.address:
+            await self._flush_borrow_reports()
+
+    async def _flush_borrow_reports(self) -> None:
+        reports = self.ref_counter.drain_borrow_reports()
+        for owner, ops in reports.items():
+            if owner == self.address:
+                continue
+            try:
+                client = RpcClient(*owner, name="borrow-report")
+                await client.notify(
+                    "update_borrows", borrower=self.address,
+                    ops=[(op, o.binary()) for op, o in ops])
+                await client.close()
+            except Exception:
+                # Transient failure must not lose protocol state: a lost add
+                # frees under a live borrower, a lost remove pins forever.
+                self.ref_counter.requeue_borrow_reports(owner, ops)
+
+    async def _borrower_audit_loop(self) -> None:
+        """Owner side: reconcile borrower sets against reality so a borrower
+        that died (or whose removal report was lost) doesn't pin our objects
+        forever (reference: WaitForRefRemoved, reference_count.h:73).
+
+        A borrow is only dropped after it is observed missing/unreachable in
+        two consecutive rounds — one blip (network or check-then-act with an
+        in-flight task carrying the ref) must not free a live object."""
+        misses: Dict[Tuple[Tuple[str, int], ObjectID], int] = {}
+        while not self._shutdown:
+            await asyncio.sleep(5.0)
+            snapshot = self.ref_counter.borrower_snapshot()
+            seen: set = set()
+            for borrower, oids in snapshot.items():
+                if borrower == self.address:
                     continue
                 try:
-                    client = RpcClient(*owner, name="borrow-report")
-                    await client.notify(
-                        "add_borrows", borrower=self.address,
-                        object_ids=[o.binary() for o in oids])
+                    client = RpcClient(*borrower, name="borrow-audit")
+                    held = await client.call(
+                        "check_borrows",
+                        object_ids=[o.binary() for o in oids], timeout=10)
                     await client.close()
+                    held_set = {bytes(h) for h in held}
                 except Exception:
-                    pass
+                    held_set = set()  # unreachable this round
+                for oid in oids:
+                    key = (borrower, oid)
+                    seen.add(key)
+                    if oid.binary() in held_set:
+                        misses.pop(key, None)
+                        continue
+                    misses[key] = misses.get(key, 0) + 1
+                    if misses[key] >= 2:
+                        misses.pop(key, None)
+                        self.ref_counter.remove_borrower(oid, borrower)
+            # Drop miss counters for borrows that no longer exist.
+            for key in [k for k in misses if k not in seen]:
+                del misses[key]

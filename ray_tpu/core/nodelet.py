@@ -32,6 +32,49 @@ from ray_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def _sweep_dead_arenas(shm_dir: str = "/dev/shm") -> int:
+    """Unlink ray_tpu arenas whose owning nodelet is dead (a SIGKILL'd run
+    leaks its arena with the full capacity committed — MADV_POPULATE pages).
+    Ownership = sidecar <arena>.pid; no sidecar + old mtime = pre-crash
+    leftover. Returns the number of arenas reclaimed."""
+    reclaimed = 0
+    try:
+        names = os.listdir(shm_dir)
+    except OSError:
+        return 0
+    now = time.time()
+    for name in names:
+        if not name.startswith("ray_tpu_") or name.endswith(".pid"):
+            continue
+        arena = os.path.join(shm_dir, name)
+        pid_file = arena + ".pid"
+        dead = False
+        try:
+            with open(pid_file) as f:
+                pid = int(f.read().strip())
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                dead = True
+            except PermissionError:
+                pass  # alive, other user
+        except (OSError, ValueError):
+            # No/garbled sidecar: reclaim only if clearly stale.
+            try:
+                dead = now - os.path.getmtime(arena) > 300
+            except OSError:
+                continue
+        if dead:
+            for p in (arena, pid_file):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            reclaimed += 1
+            logger.info("reclaimed dead shm arena %s", arena)
+    return reclaimed
+
+
 class WorkerHandle:
     def __init__(self, worker_id: WorkerID, proc: subprocess.Popen,
                  env_key: str):
@@ -74,10 +117,18 @@ class Nodelet:
         self.store_path = os.path.join(
             "/dev/shm", f"ray_tpu_{os.path.basename(session_dir)}_{self.node_name}"
         )
+        _sweep_dead_arenas()
         if os.path.exists(self.store_path):
             os.unlink(self.store_path)
         self.store = SharedMemoryStore(self.store_path, capacity=store_capacity,
                                        create=True)
+        # Ownership marker: lets a later nodelet's sweep reclaim this arena if
+        # this process dies without running stop() (SIGKILL'd driver etc.).
+        try:
+            with open(self.store_path + ".pid", "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self._gcs: Optional[RpcClient] = None
         self._background: List[asyncio.Task] = []
@@ -124,8 +175,9 @@ class Nodelet:
             await self._gcs.close()
         await self.server.stop()
         self.store.close()
-        if os.path.exists(self.store_path):
-            os.unlink(self.store_path)
+        for p in (self.store_path, self.store_path + ".pid"):
+            if os.path.exists(p):
+                os.unlink(p)
 
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:283)
@@ -340,13 +392,11 @@ class Nodelet:
         obj = self.store.get_serialized(oid)
         if obj is None:
             return None
-        try:
-            return {
-                "metadata": bytes(obj.metadata),
-                "buffers": [bytes(b) for b in obj.buffers],
-            }
-        finally:
-            self.store.release(oid)
+        # The read pin auto-releases when obj's buffers are dropped.
+        return {
+            "metadata": bytes(obj.metadata),
+            "buffers": [bytes(b) for b in obj.buffers],
+        }
 
     async def rpc_ping(self) -> str:
         return "pong"
@@ -450,8 +500,19 @@ def main() -> None:  # pragma: no cover - exercised via subprocess
         for sig in (signal.SIGTERM, signal.SIGINT):
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
-        # Reap workers before exiting — otherwise they leak past the session.
-        await nodelet.stop()
+        try:
+            # Reap workers before exiting — otherwise they leak past the
+            # session. Bounded: a hung teardown must not outlive the
+            # driver's kill grace period with the arena still on disk.
+            await asyncio.wait_for(nodelet.stop(), 8)
+        except Exception:
+            pass
+        finally:
+            for p in (nodelet.store_path, nodelet.store_path + ".pid"):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
 
     asyncio.run(_run())
 

@@ -175,6 +175,8 @@ class SharedMemoryStore:
         return self._mem[off.value : off.value + size.value]
 
     def release(self, object_id: ObjectID) -> None:
+        if not self._handle:  # store closed; pin dies with the mapping
+            return
         self._lib.shm_store_release(self._handle, object_id.binary())
 
     def contains(self, object_id: ObjectID) -> bool:
@@ -195,10 +197,23 @@ class SharedMemoryStore:
 
     def get_serialized(self, object_id: ObjectID) -> Optional[SerializedObject]:
         """Reconstruct a SerializedObject. Buffers are zero-copy memoryviews
-        into the arena; the object stays pinned until release()."""
+        into the arena. The read pin is tied to the buffers' lifetime: when
+        the last consumer (including numpy arrays deserialized zero-copy on
+        top of them) is garbage-collected, the pin is released and the object
+        becomes evictable — the plasma client's Buffer-release semantics
+        (reference: plasma/client.h Release on buffer destruction)."""
         view = self.get_raw(object_id)
         if view is None:
             return None
+        import weakref
+
+        import numpy as np
+
+        # All handed-out buffers are views of `anchor`; its finalizer fires
+        # once every consumer has dropped its reference.
+        anchor = np.frombuffer(view, dtype=np.uint8)
+        weakref.finalize(anchor, self.release, object_id)
+        avm = memoryview(anchor)
         (mlen,) = struct.unpack(">I", view[:4])
         metadata = bytes(view[4 : 4 + mlen])
         pos = 4 + mlen
@@ -208,7 +223,7 @@ class SharedMemoryStore:
         for _ in range(nbufs):
             (blen,) = struct.unpack(">Q", view[pos : pos + 8])
             pos += 8
-            buffers.append(view[pos : pos + blen])
+            buffers.append(avm[pos : pos + blen])
             pos += blen
         return SerializedObject(metadata, buffers, [])  # type: ignore[arg-type]
 

@@ -47,8 +47,14 @@ class CheckpointManager:
         self.score_attribute = score_attribute
         self.score_order = score_order
         self._entries: list = []  # (score, index, path, metrics)
-        self._index = 0
         os.makedirs(storage_path, exist_ok=True)
+        # Resume numbering past any checkpoints already in storage so a rerun
+        # with the same name/path never collides with (or nests into) them.
+        existing = [d for d in os.listdir(storage_path)
+                    if d.startswith("checkpoint_")]
+        self._index = max(
+            (int(d.rsplit("_", 1)[1]) for d in existing
+             if d.rsplit("_", 1)[1].isdigit()), default=0)
 
     def register(self, source_dir: str,
                  metrics: Dict[str, Any], move: bool = False) -> Checkpoint:
@@ -56,6 +62,8 @@ class CheckpointManager:
         dest = os.path.join(self.storage_path,
                             f"checkpoint_{self._index:06d}")
         if move:
+            if os.path.isdir(dest):  # stale leftover; never nest into it
+                shutil.rmtree(dest, ignore_errors=True)
             shutil.move(source_dir, dest)
         else:
             shutil.copytree(source_dir, dest, dirs_exist_ok=True)
