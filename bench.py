@@ -70,8 +70,41 @@ def bench_put_gigabytes(ray_tpu, size_mb=100, iters=10):
     return size_mb * iters / 1024 / dt
 
 
+def bench_tpu_model():
+    """Model-level TPU metrics (MFU, tokens/s, flash kernel speedup). Runs in
+    the driver process BEFORE the cluster starts so only one process holds
+    the chip. Skipped off-TPU."""
+    try:
+        import jax
+
+        if jax.default_backend() not in ("tpu",):
+            return None
+        from ray_tpu.benchmarks import flash_attention_bench, llama_train_bench
+
+        flash = flash_attention_bench()
+        llama = llama_train_bench()
+        return {"flash": flash, "llama": llama}
+    except Exception as e:  # never block the control-plane bench
+        print(f"tpu model bench skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return None
+
+
 def main():
     import ray_tpu
+
+    tpu = bench_tpu_model()
+    if tpu:
+        f, m = tpu["flash"], tpu["llama"]
+        print(
+            f"llama_0p5b_train_tokens_per_s: {m['tokens_per_s']:.0f} "
+            f"(MFU {m['mfu']*100:.1f}%, {m['params']/1e6:.0f}M params, "
+            f"step {m['step_ms']:.1f} ms)\n"
+            f"flash_attention_tflops: {f['flash_tflops']:.1f} "
+            f"(speedup vs jnp reference {f['speedup_vs_reference']:.2f}x, "
+            f"max_abs_err {f['max_abs_err']:.4f})",
+            file=sys.stderr,
+        )
 
     ray_tpu.init(object_store_memory=2 * 1024 * 1024 * 1024)
     try:
@@ -84,6 +117,15 @@ def main():
         async_rate = bench_actor_calls_async(ray_tpu)
         task_rate = bench_tasks_async(ray_tpu)
         put_gbps = bench_put_gigabytes(ray_tpu)
+        try:
+            from ray_tpu.benchmarks import mnist_trainer_bench
+
+            mnist = mnist_trainer_bench(ray_tpu)
+            print(f"mnist_mlp_trainer_samples_per_s: "
+                  f"{mnist['samples_per_s']:.0f}", file=sys.stderr)
+        except Exception as e:
+            print(f"mnist trainer bench skipped: {type(e).__name__}: {e}",
+                  file=sys.stderr)
         print(
             f"1_1_actor_calls_async: {async_rate:.1f}/s (ref 8219.8)\n"
             f"single_client_tasks_async: {task_rate:.1f}/s (ref 7971.8)\n"

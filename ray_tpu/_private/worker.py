@@ -103,12 +103,18 @@ class LeasePool:
     leased workers and returns leases when drained (reference:
     NormalTaskSubmitter lease pooling + ReportWorkerBacklog)."""
 
-    def __init__(self, worker: "Worker", sched_key: Tuple, spec_template: TaskSpec):
+    def __init__(self, worker: "Worker", sched_key: Tuple,
+                 spec_template: TaskSpec,
+                 target_node: Optional[bytes] = None):
         self.worker = worker
         self.sched_key = sched_key
         self.resources = dict(spec_template.resources)
         self.runtime_env = spec_template.runtime_env
         self.strategy = spec_template.scheduling_strategy
+        # SPREAD pools are per-node: the submitter round-robins tasks across
+        # alive nodes at submission time (reference: spread_scheduling_policy
+        # assigns the node per task, not per lease).
+        self.target_node = target_node
         self.queue: asyncio.Queue = asyncio.Queue()
         self.num_leased = 0
         self.requesting = 0
@@ -143,6 +149,12 @@ class LeasePool:
                 bytes.fromhex(self.strategy.node_id))
             return client, None
         if isinstance(self.strategy, SpreadStrategy):
+            if self.target_node is not None:
+                try:
+                    client = await w.nodelet_client_for_node(self.target_node)
+                    return client, None
+                except Exception:
+                    pass  # assigned node gone — fall through to a GCS pick
             pick = await w.gcs_client.call(
                 "pick_node", resources=self.resources, strategy="spread")
             if pick is None:
@@ -229,17 +241,69 @@ class LeasePool:
         worker_id = lease["worker_id"]
         addr = tuple(lease["worker_address"])
         client = RpcClient(*addr, name="leased-worker")
+        cfg = get_config()
+        max_batch = max(1, cfg.task_batch_size)
+        window = asyncio.Semaphore(max(1, cfg.task_push_window))
+        pending: set = set()
+        dead = False
         try:
-            while True:
-                try:
-                    spec: TaskSpec = self.queue.get_nowait()
-                except asyncio.QueueEmpty:
+            while not dead:
+                # Fairness: this lease takes ~its share of the queue, so a
+                # fast-granted local lease cannot starve spillback/SPREAD
+                # leases that are still being acquired (the reference spreads
+                # backlog across granted leases the same way).
+                active = max(1, self.num_leased + self.requesting)
+                qsize = self.queue.qsize()
+                limit = max(1, min(max_batch, -(-qsize // active)))
+                deep = qsize > active * max_batch
+                if not deep and pending:
+                    # Shallow queue: no pipelining — finish what's in flight
+                    # before taking more, letting other leases claim work.
+                    await asyncio.wait(pending,
+                                       return_when=asyncio.FIRST_COMPLETED)
+                    continue
+                batch: List[TaskSpec] = []
+                while len(batch) < limit:
+                    try:
+                        batch.append(self.queue.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                if not batch:
+                    if pending:
+                        # Let in-flight batches finish; their completion often
+                        # unlocks dependents that enqueue more work here.
+                        await asyncio.wait(pending,
+                                           return_when=asyncio.FIRST_COMPLETED)
+                        continue
+                    # Lease linger: hold the warm worker briefly — a following
+                    # submission wave reuses it without a lease round trip.
+                    try:
+                        batch.append(await asyncio.wait_for(
+                            self.queue.get(), cfg.lease_linger_s))
+                    except asyncio.TimeoutError:
+                        break
+                await window.acquire()
+                if dead:
+                    for spec in batch:
+                        self.queue.put_nowait(spec)
+                    window.release()
                     break
-                worker_alive = await self.worker.push_task_to(client, addr, spec)
-                if not worker_alive:
-                    # The leased worker died; drop the lease — any retry was
-                    # re-queued and will get a fresh worker.
-                    break
+
+                async def one_batch(specs=batch):
+                    nonlocal dead
+                    try:
+                        alive = await self.worker.push_task_batch_to(
+                            client, addr, specs)
+                        if not alive:
+                            dead = True
+                    finally:
+                        window.release()
+
+                t = asyncio.ensure_future(one_batch())
+                pending.add(t)
+                t.add_done_callback(pending.discard)
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
         finally:
             self.num_leased -= 1
             await client.close()
@@ -266,6 +330,7 @@ class ActorSubmitter:
         self.address: Optional[Tuple[str, int]] = None
         self.queue: asyncio.Queue = asyncio.Queue()
         self._pump_task: Optional[asyncio.Task] = None
+        self._held: Optional[tuple] = None
 
     def enqueue(self, spec: TaskSpec, max_task_retries: int) -> None:
         self.queue.put_nowait((spec, max_task_retries, 0))
@@ -275,14 +340,31 @@ class ActorSubmitter:
     MAX_BATCH = 32
 
     async def _pump(self) -> None:
-        while not self.queue.empty():
+        while self._held is not None or not self.queue.empty():
             # Adaptive batching: drain whatever is queued (up to MAX_BATCH)
             # into one RPC frame — collapses per-call frame/syscall/task
             # overhead for pipelined submitters while a lone call still goes
-            # out immediately as a batch of one.
+            # out immediately as a batch of one. Dependency gating stays in
+            # FIFO order (sync-actor ordering contract): a task whose owned
+            # args are pending flushes the batch ahead of it, then waits.
             batch = []
-            while len(batch) < self.MAX_BATCH and not self.queue.empty():
-                batch.append(self.queue.get_nowait())
+            while len(batch) < self.MAX_BATCH:
+                if self._held is not None:
+                    item, self._held = self._held, None
+                else:
+                    try:
+                        item = self.queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                deps = self.worker.unresolved_owned_deps(item[0])
+                if deps:
+                    if batch:
+                        self._held = item
+                        break
+                    await self.worker.wait_owned_deps(deps)
+                batch.append(item)
+            if not batch:
+                continue
             try:
                 client = await self._ensure_client()
                 if len(batch) == 1:
@@ -428,13 +510,19 @@ class Worker:
         self._put_lock = threading.Lock()
         self._task_counter_lock = threading.Lock()
         self._lease_pools: Dict[Tuple, LeasePool] = {}
+        self._submit_buf: List[TaskSpec] = []
+        self._submit_buf_lock = threading.Lock()
+        self._spread_nodes: List[bytes] = []
+        self._spread_rr = 0
+        self._spread_refresh_started = False
         self._actor_submitters: Dict[ActorID, ActorSubmitter] = {}
         self._actor_seq_nos: Dict[ActorID, int] = {}
         # Remote nodelet clients for cluster-wide leasing, keyed by node id.
         self._nodelet_clients: Dict[bytes, RpcClient] = {}
         # Execution side.
         self._task_executor = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix="task_exec")
+            max_workers=max(1, get_config().task_executor_threads),
+            thread_name_prefix="task_exec")
         self._actor_instance: Any = None
         self._actor_creation_spec: Optional[TaskSpec] = None
         self._actor_executors: Dict[str, concurrent.futures.ThreadPoolExecutor] = {}
@@ -459,6 +547,14 @@ class Worker:
             await self.nodelet_client.connect()
             asyncio.ensure_future(self._borrow_report_loop())
             asyncio.ensure_future(self._borrower_audit_loop())
+            # Prime the spread-RR node cache so the first SPREAD wave
+            # already distributes (the refresh loop keeps it fresh).
+            try:
+                nodes = await self.gcs_client.call("list_nodes")
+                self._spread_nodes = [n["node_id"] for n in nodes
+                                      if n["alive"]]
+            except Exception:
+                pass
 
         self.loop_thread.run(_setup())
         self.connected = True
@@ -512,6 +608,7 @@ class Worker:
     def _register_handlers(self) -> None:
         s = self.server
         s.register("push_task", self._rpc_push_task)
+        s.register("push_task_batch", self._rpc_push_task_batch)
         s.register("create_actor", self._rpc_create_actor)
         s.register("push_actor_task", self._rpc_push_actor_task)
         s.register("push_actor_task_batch", self._rpc_push_actor_task_batch)
@@ -797,16 +894,132 @@ class Worker:
             self.ref_counter.add_owned_ref(oid)
             refs.append(ObjectRef(oid, owner_address=self.address))
 
-        def _enqueue():
-            pool = self._lease_pools.get(spec.scheduling_key())
+        # Coalesced handoff to the loop: one wakeup drains a whole submission
+        # wave (a per-task call_soon_threadsafe self-pipe write would cost a
+        # syscall per task).
+        with self._submit_buf_lock:
+            first = not self._submit_buf
+            self._submit_buf.append(spec)
+        if first:
+            self.loop.call_soon_threadsafe(self._drain_submit_buf)
+        return refs
+
+    def _drain_submit_buf(self) -> None:
+        with self._submit_buf_lock:
+            specs, self._submit_buf = self._submit_buf, []
+        touched = []
+        for spec in specs:
+            key = spec.scheduling_key()
+            target_node = None
+            if isinstance(spec.scheduling_strategy, SpreadStrategy):
+                target_node = self._next_spread_node()
+                if target_node is not None:
+                    key = key + (target_node,)
+            pool = self._lease_pools.get(key)
             if pool is None:
-                pool = LeasePool(self, spec.scheduling_key(), spec)
-                self._lease_pools[spec.scheduling_key()] = pool
-            pool.queue.put_nowait(spec)
+                pool = LeasePool(self, key, spec, target_node=target_node)
+                self._lease_pools[key] = pool
+            # Owner-side dependency resolution (reference:
+            # dependency_resolver.h — a task is dispatched only once its args
+            # exist). Without this, a dependent task batched together with
+            # its upstream deadlocks: the executor blocks resolving the arg
+            # while the upstream's result rides the same batch reply.
+            deps = self.unresolved_owned_deps(spec)
+            if deps:
+                async def _when_ready(pool=pool, spec=spec, deps=deps):
+                    await self.wait_owned_deps(deps)
+                    pool.queue.put_nowait(spec)
+                    pool.maybe_scale_up()
+
+                asyncio.ensure_future(_when_ready())
+            else:
+                pool.queue.put_nowait(spec)
+                if pool not in touched:
+                    touched.append(pool)
+        for pool in touched:
             pool.maybe_scale_up()
 
-        self.loop.call_soon_threadsafe(_enqueue)
-        return refs
+    def _next_spread_node(self) -> Optional[bytes]:
+        """Round-robin over the cached alive-node list (refreshed every 1s
+        by a background loop started on first SPREAD submission)."""
+        if not self._spread_refresh_started:
+            self._spread_refresh_started = True
+
+            async def _refresh_loop():
+                while not self._shutdown:
+                    try:
+                        nodes = await self.gcs_client.call("list_nodes")
+                        self._spread_nodes = [n["node_id"] for n in nodes
+                                              if n["alive"]]
+                    except Exception:
+                        pass
+                    await asyncio.sleep(1.0)
+
+            asyncio.ensure_future(_refresh_loop())
+        if not self._spread_nodes:
+            return None
+        self._spread_rr += 1
+        return self._spread_nodes[self._spread_rr % len(self._spread_nodes)]
+
+    def unresolved_owned_deps(self, spec: TaskSpec) -> List[ObjectID]:
+        """Top-level ref args owned by this process whose values are not yet
+        available. (Borrowed refs resolve against their remote owner at
+        execution time and cannot deadlock on our own reply pipeline.)"""
+        deps: List[ObjectID] = []
+        for a in list(spec.args) + list(spec.kwargs.values()):
+            if a[0] != "ref":
+                continue
+            r = a[1]
+            if (r.owner_address is not None
+                    and tuple(r.owner_address) != self.address):
+                continue
+            if (self.memory_store.get_if_exists(r.id) is None
+                    and not self.shm.contains(r.id)):
+                deps.append(r.id)
+        return deps
+
+    async def wait_owned_deps(self, deps: List[ObjectID]) -> None:
+        await asyncio.gather(
+            *[self.memory_store.get(d, None) for d in deps])
+
+    async def push_task_batch_to(self, client: RpcClient,
+                                 addr: Tuple[str, int],
+                                 specs: List[TaskSpec]) -> bool:
+        """Push a batch of tasks in one RPC. Returns False when the worker is
+        unusable (connection lost) so the caller drops the lease. Failed
+        specs are retried or failed permanently, mirroring push_task_to."""
+        if len(specs) == 1:
+            return await self.push_task_to(client, addr, specs[0])
+        for spec in specs:
+            self.task_manager.mark_inflight(spec.task_id, addr)
+        try:
+            reply = await client.call(
+                "push_task_batch", specs=[ser_spec(s) for s in specs],
+                timeout=86400.0)
+            replies = reply["replies"]
+        except (ConnectionLost, RemoteError, asyncio.TimeoutError, OSError) as e:
+            for spec in specs:
+                retry_spec = self.task_manager.fail_or_retry(spec.task_id)
+                if retry_spec is not None:
+                    pool = self._lease_pools.get(spec.scheduling_key())
+                    if pool is not None:
+                        pool.queue.put_nowait(retry_spec)
+                        pool.maybe_scale_up()
+                else:
+                    err = WorkerCrashedError(
+                        f"task {spec.function_name} failed: worker died ({e!r})")
+                    self.task_manager.fail_permanently(
+                        spec.task_id, ser.serialize_error(err))
+            return not isinstance(e, (ConnectionLost, OSError))
+        except Exception as e:
+            logger.exception("push_task_batch failed locally")
+            for spec in specs:
+                self.task_manager.fail_permanently(
+                    spec.task_id, ser.serialize_error(e))
+            return True
+        for spec, item in zip(specs, replies):
+            await self.handle_task_reply(spec, item)
+        return True
 
     async def push_task_to(self, client: RpcClient, addr: Tuple[str, int],
                            spec: TaskSpec) -> bool:
@@ -865,14 +1078,20 @@ class Worker:
                     else:
                         forward.setdefault(tuple(owner), []).append(ob)
                 for owner, obs in forward.items():
+                    client = None
                     try:
                         client = RpcClient(*owner, name="borrow-forward")
                         await client.notify(
                             "update_borrows", borrower=list(b),
                             ops=[("add", ob) for ob in obs])
-                        await client.close()
                     except Exception:
                         pass  # executor's own 1s add report is the fallback
+                    finally:
+                        if client is not None:
+                            try:
+                                await client.close()
+                            except Exception:
+                                pass
         if reply.get("cancelled"):
             self.task_manager.fail_permanently(
                 spec.task_id,
@@ -1010,6 +1229,20 @@ class Worker:
         return await loop.run_in_executor(
             self._task_executor, self._execute_task_sync, task_spec)
 
+    async def _rpc_push_task_batch(self, specs: List[bytes]) -> Dict[str, Any]:
+        """Execute a batch of normal tasks (one RPC frame per submitter
+        pipeline window). The whole batch runs in ONE executor hop — a
+        thread handoff per task would dominate short tasks; cross-batch
+        concurrency still comes from the submitter's pipeline window landing
+        multiple batches on different executor threads."""
+        loop = asyncio.get_running_loop()
+
+        def run_batch():
+            return [self._execute_task_sync(deser_spec(s)) for s in specs]
+
+        replies = await loop.run_in_executor(self._task_executor, run_batch)
+        return {"replies": replies}
+
     async def _rpc_create_actor(self, creation_spec: bytes) -> Dict[str, Any]:
         spec = deser_spec(creation_spec)
         # The actor __init__ runs on the actor executor thread, NOT on the
@@ -1038,16 +1271,66 @@ class Worker:
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
 
     async def _rpc_push_actor_task_batch(self, specs: List[bytes]) -> Dict[str, Any]:
-        """Execute a batch of actor tasks. Per-item logic is reused; gather
-        starts the items in order, so the (max_workers=1) actor executor sees
-        them in seq order and sync-actor ordering is preserved, while async
-        actor methods still interleave up to max_concurrency."""
-        replies = await asyncio.gather(
-            *[self._rpc_push_actor_task(s) for s in specs])
-        return {"replies": list(replies)}
+        """Execute a batch of actor tasks. Runs of consecutive sync methods
+        collapse into one executor hop (ordering preserved — same thread, in
+        order); async methods interleave via gather as before."""
+        decoded = [deser_spec(s) for s in specs]
+        loop = asyncio.get_running_loop()
+
+        def is_batchable_sync(spec: TaskSpec):
+            # Collapsing a run onto one thread serializes it — only legal
+            # when the actor is single-threaded anyway (max_concurrency=1);
+            # a concurrent actor's sync methods may block on each other.
+            if (self._actor_instance is None or spec.concurrency_group
+                    or (self._actor_creation_spec is not None
+                        and self._actor_creation_spec.max_concurrency > 1)):
+                return None
+            m = getattr(self._actor_instance, spec.actor_method_name, None)
+            if m is None or asyncio.iscoroutinefunction(m):
+                return None
+            return m
+
+        futs: List[Any] = []
+        sizes: List[int] = []
+        i = 0
+        while i < len(decoded):
+            method = is_batchable_sync(decoded[i])
+            if method is None:
+                futs.append(asyncio.ensure_future(
+                    self._rpc_push_actor_task_decoded(decoded[i])))
+                sizes.append(1)
+                i += 1
+                continue
+            run: List[Tuple[TaskSpec, Any]] = [(decoded[i], method)]
+            j = i + 1
+            while j < len(decoded):
+                m = is_batchable_sync(decoded[j])
+                if m is None:
+                    break
+                run.append((decoded[j], m))
+                j += 1
+
+            def run_sync(items=run):
+                return [self._execute_actor_task_sync(s, m) for s, m in items]
+
+            futs.append(loop.run_in_executor(self._actor_executors[""],
+                                             run_sync))
+            sizes.append(len(run))
+            i = j
+        results = await asyncio.gather(*futs)
+        replies: List[Dict[str, Any]] = []
+        for size, res in zip(sizes, results):
+            if size == 1 and isinstance(res, dict):
+                replies.append(res)
+            else:
+                replies.extend(res)
+        return {"replies": replies}
 
     async def _rpc_push_actor_task(self, spec: bytes) -> Dict[str, Any]:
-        task_spec = deser_spec(spec)
+        return await self._rpc_push_actor_task_decoded(deser_spec(spec))
+
+    async def _rpc_push_actor_task_decoded(
+            self, task_spec: TaskSpec) -> Dict[str, Any]:
         if self._actor_instance is None:
             return {"results": [self._error_result(
                 ActorDiedError("actor instance not initialized"))] *
@@ -1279,16 +1562,22 @@ class Worker:
         for owner, ops in reports.items():
             if owner == self.address:
                 continue
+            client = None
             try:
                 client = RpcClient(*owner, name="borrow-report")
                 await client.notify(
                     "update_borrows", borrower=self.address,
                     ops=[(op, o.binary()) for op, o in ops])
-                await client.close()
             except Exception:
                 # Transient failure must not lose protocol state: a lost add
                 # frees under a live borrower, a lost remove pins forever.
                 self.ref_counter.requeue_borrow_reports(owner, ops)
+            finally:
+                if client is not None:
+                    try:
+                        await client.close()
+                    except Exception:
+                        pass
 
     async def _borrower_audit_loop(self) -> None:
         """Owner side: reconcile borrower sets against reality so a borrower
@@ -1306,15 +1595,21 @@ class Worker:
             for borrower, oids in snapshot.items():
                 if borrower == self.address:
                     continue
+                client = None
                 try:
                     client = RpcClient(*borrower, name="borrow-audit")
                     held = await client.call(
                         "check_borrows",
                         object_ids=[o.binary() for o in oids], timeout=10)
-                    await client.close()
                     held_set = {bytes(h) for h in held}
                 except Exception:
                     held_set = set()  # unreachable this round
+                finally:
+                    if client is not None:
+                        try:
+                            await client.close()
+                        except Exception:
+                            pass
                 for oid in oids:
                     key = (borrower, oid)
                     seen.add(key)

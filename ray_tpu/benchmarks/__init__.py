@@ -1,0 +1,10 @@
+"""Model-level TPU benchmarks (reference counterpart: release/perf_metrics
+and python/ray/_private/ray_perf.py drive control-plane numbers; the reference
+publishes no model-level figures — these are the TPU north-star metrics from
+BASELINE.json)."""
+
+from ray_tpu.benchmarks.model_bench import (  # noqa: F401
+    flash_attention_bench,
+    llama_train_bench,
+    mnist_trainer_bench,
+)

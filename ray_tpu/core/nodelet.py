@@ -183,9 +183,20 @@ class Nodelet:
     # Worker pool (reference: worker_pool.h:283)
     # ------------------------------------------------------------------
     def _spawn_worker(self, env_key: str,
-                      runtime_env: Optional[Dict[str, Any]]) -> WorkerHandle:
+                      runtime_env: Optional[Dict[str, Any]],
+                      needs_tpu: bool = False) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        if not needs_tpu:
+            # Workers without a TPU lease start WITHOUT the TPU plumbing:
+            # the site hook imports jax at interpreter start (~2s of the
+            # ~2.3s worker spawn) and would contend for the chip. TPU
+            # leases (num_tpus>0) get the full environment — this is the
+            # visibility-enforcement hook (reference: TPU_VISIBLE_CHIPS in
+            # accelerators/tpu.py:110).
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            if env.get("JAX_PLATFORMS") == "axon":
+                env["JAX_PLATFORMS"] = "cpu"
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_NODELET_ADDR"] = f"{self.server.host}:{self.server.port}"
         env["RAY_TPU_GCS_ADDR"] = f"{self.gcs_address[0]}:{self.gcs_address[1]}"
@@ -223,7 +234,8 @@ class Nodelet:
         return {"ok": True}
 
     async def _get_idle_worker(
-        self, env_key: str, runtime_env: Optional[Dict[str, Any]]
+        self, env_key: str, runtime_env: Optional[Dict[str, Any]],
+        needs_tpu: bool = False,
     ) -> WorkerHandle:
         """Returns a worker already marked leased — reserving at selection
         time closes the race where two lease requests pick the same worker
@@ -234,7 +246,7 @@ class Nodelet:
                     and w.proc.poll() is None):
                 w.leased = True
                 return w
-        handle = self._spawn_worker(env_key, runtime_env)
+        handle = self._spawn_worker(env_key, runtime_env, needs_tpu)
         handle.leased = True
         try:
             await asyncio.wait_for(handle.ready.wait(),
@@ -260,7 +272,9 @@ class Nodelet:
         block: bool = True,
     ) -> Dict[str, Any]:
         req = ResourceSet(resources)
-        env_key = repr(sorted((runtime_env or {}).items()))
+        needs_tpu = float(resources.get("TPU", 0) or 0) > 0
+        env_key = repr(sorted((runtime_env or {}).items())) + (
+            "|tpu" if needs_tpu else "")
         cfg = get_config()
         deadline = time.monotonic() + cfg.worker_start_timeout_s
         while True:
@@ -270,7 +284,8 @@ class Nodelet:
             if req.fits_in(pool):
                 req.subtract_from(pool)
                 try:
-                    worker = await self._get_idle_worker(env_key, runtime_env)
+                    worker = await self._get_idle_worker(env_key, runtime_env,
+                                                         needs_tpu)
                 except Exception as e:
                     req.add_to(pool)
                     return {"ok": False, "error": f"worker start failed: {e!r}"}

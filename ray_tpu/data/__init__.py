@@ -1,0 +1,32 @@
+"""ray_tpu.data — streaming datasets over the task/object plane.
+
+Reference: python/ray/data (Dataset, read_api, DataIterator). See dataset.py
+for the redesign notes (numpy-dict blocks, generator-chain streaming
+executor)."""
+
+from ray_tpu.data.block import Block, BlockMetadata
+from ray_tpu.data.dataset import (
+    Dataset,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004
+    range_tensor,
+    read_csv,
+    read_parquet,
+)
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "Block",
+    "BlockMetadata",
+    "DataIterator",
+    "Dataset",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_csv",
+    "read_parquet",
+]

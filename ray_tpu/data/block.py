@@ -1,0 +1,98 @@
+"""Columnar blocks — the unit of data movement (reference: python/ray/data/
+block.py `Block`/`BlockMetadata`, _internal/arrow_block.py).
+
+TPU-first redesign: a block is a dict of numpy arrays (column name → column).
+Numpy-native blocks feed `jax.device_put` with zero conversion — the reference
+uses Arrow because its consumers are pandas/torch; ours are jitted programs
+whose host-side staging format IS numpy. Rows (dicts) and scalar items are
+wrapped into the single "value" column.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+
+VALUE_COL = "value"
+
+
+@dataclasses.dataclass
+class BlockMetadata:
+    num_rows: int
+    size_bytes: int
+    schema: Optional[Dict[str, Any]] = None
+
+    @staticmethod
+    def of(block: Block) -> "BlockMetadata":
+        return BlockMetadata(
+            num_rows=block_num_rows(block),
+            size_bytes=sum(v.nbytes for v in block.values()),
+            schema={k: (str(v.dtype), v.shape[1:]) for k, v in block.items()},
+        )
+
+
+def block_num_rows(block: Block) -> int:
+    if not block:
+        return 0
+    return len(next(iter(block.values())))
+
+
+def block_from_items(items: Sequence[Any]) -> Block:
+    """Items → block. Dicts become columns; everything else goes to "value"."""
+    if items and isinstance(items[0], dict):
+        cols: Dict[str, List[Any]] = {}
+        for it in items:
+            for k, v in it.items():
+                cols.setdefault(k, []).append(v)
+        return {k: np.asarray(v) for k, v in cols.items()}
+    return {VALUE_COL: np.asarray(items)}
+
+
+def block_to_items(block: Block) -> List[Any]:
+    n = block_num_rows(block)
+    if set(block.keys()) == {VALUE_COL}:
+        return list(block[VALUE_COL])
+    return [{k: block[k][i] for k in block} for i in range(n)]
+
+
+def block_slice(block: Block, start: int, end: int) -> Block:
+    return {k: v[start:end] for k, v in block.items()}
+
+
+def block_concat(blocks: Sequence[Block]) -> Block:
+    blocks = [b for b in blocks if block_num_rows(b)]
+    if not blocks:
+        return {}
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def block_select(block: Block, mask: np.ndarray) -> Block:
+    return {k: v[mask] for k, v in block.items()}
+
+
+def iter_block_batches(block: Block, batch_size: Optional[int]) -> Iterator[Block]:
+    n = block_num_rows(block)
+    if batch_size is None or batch_size >= n:
+        if n:
+            yield block
+        return
+    for i in range(0, n, batch_size):
+        yield block_slice(block, i, min(i + batch_size, n))
+
+
+def normalize_batch_output(out: Any) -> Block:
+    """User map_batches output → block. Accepts dict-of-arrays, list of rows,
+    or a numpy array (becomes the "value" column)."""
+    if isinstance(out, dict):
+        return {k: np.asarray(v) for k, v in out.items()}
+    if isinstance(out, np.ndarray):
+        return {VALUE_COL: out}
+    if isinstance(out, (list, tuple)):
+        return block_from_items(out)
+    raise TypeError(
+        f"map_batches fn must return dict/ndarray/list, got {type(out)}")

@@ -1,0 +1,406 @@
+"""Dataset: lazy logical plan + pull-based streaming execution over tasks.
+
+Reference counterparts: python/ray/data/dataset.py:160 (`Dataset`),
+_internal/execution/streaming_executor.py:52 (pull-based streaming executor
+with backpressure), data/iterator.py (`iter_batches`, `streaming_split`).
+
+Redesign notes (TPU-first, not a port):
+- Blocks are numpy-dict columns (see block.py) — the zero-copy staging format
+  for `jax.device_put`.
+- The executor is a chain of async generators over ObjectRefs: each map op
+  keeps a bounded submission window and yields results in order; pulling is
+  lazy end-to-end, so backpressure needs no separate policy object — an
+  unpulled downstream simply never advances upstream generators.
+- Transforms run as ray_tpu tasks; block refs flow through the object store
+  (shm, zero-copy on one node).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import builtins
+import itertools
+_range = builtins.range
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    BlockMetadata,
+    VALUE_COL,
+    block_concat,
+    block_from_items,
+    block_num_rows,
+    block_select,
+    block_slice,
+    block_to_items,
+    iter_block_batches,
+    normalize_batch_output,
+)
+
+DEFAULT_BLOCK_ROWS = 4096
+DEFAULT_WINDOW = 4  # concurrent transform tasks per operator
+
+
+# ---------------------------------------------------------------------------
+# Logical ops
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class _Source:
+    """Produces blocks driver-side, lazily."""
+
+    make_blocks: Callable[[], Iterator[Block]]
+    name: str = "Source"
+
+
+@dataclasses.dataclass
+class _RefSource:
+    """Blocks already in the object store (materialized datasets)."""
+
+    refs: List[Any]
+    name: str = "RefSource"
+
+
+@dataclasses.dataclass
+class _MapBatches:
+    fn: Callable
+    batch_size: Optional[int]
+    num_cpus: float = 1.0
+    window: int = DEFAULT_WINDOW
+    name: str = "MapBatches"
+    fn_kwargs: Optional[Dict[str, Any]] = None
+
+
+def _apply_map_batches(op: _MapBatches, block: Block) -> Block:
+    outs = []
+    kwargs = op.fn_kwargs or {}
+    for batch in iter_block_batches(block, op.batch_size):
+        outs.append(normalize_batch_output(op.fn(batch, **kwargs)))
+    return block_concat(outs) if outs else {}
+
+
+# ---------------------------------------------------------------------------
+# Streaming execution
+# ---------------------------------------------------------------------------
+def _exec_stream(plan: List[Any]) -> Iterator[Any]:
+    """Plan → iterator of Block ObjectRefs (pull-based; bounded windows)."""
+    src = plan[0]
+    if isinstance(src, _RefSource):
+        stream: Iterator[Any] = iter(src.refs)
+    else:
+        stream = (ray_tpu.put(b) for b in src.make_blocks())
+
+    for op in plan[1:]:
+        stream = _map_stream(op, stream)
+    return stream
+
+
+def _map_stream(op: _MapBatches, upstream: Iterator[Any]) -> Iterator[Any]:
+    from collections import deque
+
+    @ray_tpu.remote
+    def _run(block: Block, op=op) -> Block:
+        return _apply_map_batches(op, block)
+
+    remote = _run.options(num_cpus=op.num_cpus)
+    inflight: "deque[Any]" = deque()
+    for ref in upstream:
+        inflight.append(remote.remote(ref))
+        if len(inflight) >= max(1, op.window):
+            yield inflight.popleft()
+    while inflight:
+        yield inflight.popleft()
+
+
+class Dataset:
+    """Lazy dataset of columnar blocks (reference: data/dataset.py:160)."""
+
+    def __init__(self, plan: List[Any]):
+        self._plan = plan
+
+    # -- transforms (lazy) ------------------------------------------------
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    num_cpus: float = 1.0, concurrency: int = DEFAULT_WINDOW,
+                    fn_kwargs: Optional[Dict[str, Any]] = None) -> "Dataset":
+        return Dataset(self._plan + [_MapBatches(
+            fn, batch_size, num_cpus, concurrency,
+            name=getattr(fn, "__name__", "map_batches"), fn_kwargs=fn_kwargs)])
+
+    def map(self, fn: Callable, **opts) -> "Dataset":
+        def _map_rows(batch: Block) -> Block:
+            return block_from_items([fn(r) for r in block_to_items(batch)])
+
+        return self.map_batches(_map_rows, **opts)
+
+    def flat_map(self, fn: Callable, **opts) -> "Dataset":
+        def _flat(batch: Block) -> Block:
+            out: List[Any] = []
+            for r in block_to_items(batch):
+                out.extend(fn(r))
+            return block_from_items(out)
+
+        return self.map_batches(_flat, **opts)
+
+    def filter(self, fn: Callable, **opts) -> "Dataset":
+        def _filter(batch: Block) -> Block:
+            mask = np.asarray([bool(fn(r)) for r in block_to_items(batch)])
+            return block_select(batch, mask) if len(mask) else batch
+
+        return self.map_batches(_filter, **opts)
+
+    def add_column(self, name: str, fn: Callable, **opts) -> "Dataset":
+        def _add(batch: Block) -> Block:
+            out = dict(batch)
+            out[name] = np.asarray(fn(batch))
+            return out
+
+        return self.map_batches(_add, **opts)
+
+    def drop_columns(self, cols: Sequence[str], **opts) -> "Dataset":
+        def _drop(batch: Block) -> Block:
+            return {k: v for k, v in batch.items() if k not in cols}
+
+        return self.map_batches(_drop, **opts)
+
+    def select_columns(self, cols: Sequence[str], **opts) -> "Dataset":
+        def _select(batch: Block) -> Block:
+            return {k: batch[k] for k in cols}
+
+        return self.map_batches(_select, **opts)
+
+    # -- consumption ------------------------------------------------------
+    def iter_block_refs(self) -> Iterator[Any]:
+        return _exec_stream(self._plan)
+
+    def iter_blocks(self) -> Iterator[Block]:
+        for ref in self.iter_block_refs():
+            yield ray_tpu.get(ref)
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     prefetch_batches: int = 1,
+                     drop_last: bool = False) -> Iterator[Block]:
+        """Re-batched streaming iteration (reference: data/iterator.py)."""
+        leftover: Optional[Block] = None
+        for block in self.iter_blocks():
+            if leftover is not None and block_num_rows(leftover):
+                block = block_concat([leftover, block])
+                leftover = None
+            if batch_size is None:
+                yield block
+                continue
+            n = block_num_rows(block)
+            i = 0
+            while n - i >= batch_size:
+                yield block_slice(block, i, i + batch_size)
+                i += batch_size
+            if i < n:
+                leftover = block_slice(block, i, n)
+        if leftover is not None and block_num_rows(leftover) and not drop_last:
+            yield leftover
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self.iter_blocks():
+            yield from block_to_items(block)
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= limit:
+                break
+        return out
+
+    def take_all(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        if isinstance(self._plan[0], _RefSource) and len(self._plan) == 1:
+            return sum(ray_tpu.get(_remote_num_rows().remote(r))
+                       for r in self._plan[0].refs)
+        return sum(block_num_rows(b) for b in self.iter_blocks())
+
+    def schema(self) -> Optional[Dict[str, Any]]:
+        for block in self.iter_blocks():
+            return BlockMetadata.of(block).schema
+        return None
+
+    def materialize(self) -> "Dataset":
+        refs = list(self.iter_block_refs())
+        return Dataset([_RefSource(refs)])
+
+    def num_blocks(self) -> int:
+        return len(self.materialize()._plan[0].refs)
+
+    # -- reorganization ---------------------------------------------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        full = block_concat(list(self.iter_blocks()))
+        n = block_num_rows(full)
+        per = max(1, -(-n // num_blocks))
+
+        def gen(full=full, n=n, per=per):
+            for i in _range(0, n, per):
+                yield block_slice(full, i, min(i + per, n))
+
+        return Dataset([_Source(gen, name="Repartition")])
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        full = block_concat(list(self.iter_blocks()))
+        n = block_num_rows(full)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(n)
+        shuffled = {k: v[perm] for k, v in full.items()}
+        nb = max(1, n // DEFAULT_BLOCK_ROWS)
+        per = -(-n // nb)
+
+        def gen(shuffled=shuffled, n=n, per=per):
+            for i in _range(0, n, per):
+                yield block_slice(shuffled, i, min(i + per, n))
+
+        return Dataset([_Source(gen, name="RandomShuffle")])
+
+    def split(self, n: int) -> List["Dataset"]:
+        refs = list(self.iter_block_refs())
+        out = []
+        for i in _range(n):
+            out.append(Dataset([_RefSource(refs[i::n])]))
+        return out
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        plans = [self._plan] + [o._plan for o in others]
+
+        def gen(plans=plans):
+            for p in plans:
+                for ref in _exec_stream(p):
+                    yield ray_tpu.get(ref)
+
+        return Dataset([_Source(gen, name="Union")])
+
+    # -- train integration ------------------------------------------------
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["DataIterator"]:
+        """N coordinated iterators for N train workers (reference:
+        data/iterator.py streaming_split + SplitCoordinator actor)."""
+        from ray_tpu.data.iterator import DataIterator, _SplitCoordinator
+
+        Coord = ray_tpu.remote(_SplitCoordinator)
+        coord = Coord.options(num_cpus=0.5).remote(self._plan, n)
+        return [DataIterator(coordinator=coord, split_idx=i)
+                for i in _range(n)]
+
+    def iterator(self) -> "DataIterator":
+        from ray_tpu.data.iterator import DataIterator
+
+        return DataIterator(dataset=self)
+
+    # -- write ------------------------------------------------------------
+    def write_parquet(self, path: str) -> None:
+        import os
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            table = pa.table({k: list(v) if v.ndim > 1 else v
+                              for k, v in block.items()})
+            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def stats(self) -> str:
+        names = [getattr(op, "name", type(op).__name__) for op in self._plan]
+        return " -> ".join(names)
+
+    def __repr__(self) -> str:
+        return f"Dataset(plan={self.stats()})"
+
+
+def _remote_num_rows():
+    @ray_tpu.remote
+    def _n(block: Block) -> int:
+        return block_num_rows(block)
+
+    return _n
+
+
+# ---------------------------------------------------------------------------
+# Read API (reference: python/ray/data/read_api.py)
+# ---------------------------------------------------------------------------
+def from_items(items: Sequence[Any], *,
+               block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:
+    items = list(items)
+
+    def gen():
+        for i in _range(0, len(items), block_rows):
+            yield block_from_items(items[i:i + block_rows])
+
+    return Dataset([_Source(gen, name="FromItems")])
+
+
+def range(n: int, *, block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:  # noqa: A001
+    def gen():
+        for i in _range(0, n, block_rows):
+            yield {"id": np.arange(i, min(i + block_rows, n))}
+
+    return Dataset([_Source(gen, name="Range")])
+
+
+def range_tensor(n: int, *, shape=(1,),
+                 block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:
+    def gen():
+        for i in _range(0, n, block_rows):
+            ids = np.arange(i, min(i + block_rows, n))
+            data = np.broadcast_to(
+                ids.reshape((-1,) + (1,) * len(shape)),
+                (len(ids),) + tuple(shape)).copy()
+            yield {"data": data}
+
+    return Dataset([_Source(gen, name="RangeTensor")])
+
+
+def from_numpy(arr: np.ndarray, *, column: str = "data",
+               block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:
+    def gen():
+        for i in _range(0, len(arr), block_rows):
+            yield {column: arr[i:i + block_rows]}
+
+    return Dataset([_Source(gen, name="FromNumpy")])
+
+
+def from_pandas(df) -> Dataset:
+    def gen():
+        yield {c: df[c].to_numpy() for c in df.columns}
+
+    return Dataset([_Source(gen, name="FromPandas")])
+
+
+def read_parquet(path: str) -> Dataset:
+    """One block per parquet file (reference: read_api.py read_parquet)."""
+    import glob
+    import os
+
+    paths = ([os.path.join(path, p) for p in sorted(glob.glob(
+        os.path.join(path, "*.parquet")))] if os.path.isdir(path)
+        else sorted(glob.glob(path)) or [path])
+
+    def gen():
+        import pyarrow.parquet as pq
+
+        for p in paths:
+            table = pq.read_table(p)
+            yield {name: np.asarray(table[name])
+                   for name in table.column_names}
+
+    return Dataset([_Source(gen, name="ReadParquet")])
+
+
+def read_csv(path: str) -> Dataset:
+    def gen():
+        import csv
+
+        with open(path) as f:
+            rows = list(csv.DictReader(f))
+        if rows:
+            yield block_from_items(rows)
+
+    return Dataset([_Source(gen, name="ReadCSV")])

@@ -1,0 +1,132 @@
+"""DataIterator + split coordination for Train ingest.
+
+Reference: python/ray/data/iterator.py (`DataIterator.iter_batches`) and the
+streaming_split SplitCoordinator actor
+(_internal/execution/operators/output_splitter.py). Redesign: the coordinator
+is a plain actor running the streaming executor; consumers pull block refs
+round-robin with per-split buffering — pulling is the backpressure.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional
+
+from ray_tpu.data.block import (
+    Block,
+    block_concat,
+    block_num_rows,
+    block_slice,
+)
+
+
+class _SplitCoordinator:
+    """Actor: executes the plan once, deals blocks to n splits round-robin.
+
+    `next_ref(i)` returns the next block ObjectRef for split i, or None at
+    end of stream. Per-split queues stay shallow: the stream only advances
+    when some split's queue is empty — consumers collectively apply
+    backpressure."""
+
+    def __init__(self, plan: List[Any], n: int):
+        self._plan = plan
+        self._n = n
+        self._queues: List[List[Any]] = [[] for _ in range(n)]
+        self._stream = None
+        self._exhausted = False
+        self._rr = 0
+
+    def _ensure_stream(self):
+        if self._stream is None:
+            from ray_tpu.data.dataset import _exec_stream
+
+            self._stream = _exec_stream(self._plan)
+
+    def next_block(self, split_idx: int) -> Optional[Block]:
+        """Returns the next block for split i (as a value — task-result
+        ownership transfers it to the caller; handing out raw refs would race
+        the coordinator's ref-count drop against the consumer's borrow)."""
+        self._ensure_stream()
+        q = self._queues[split_idx]
+        while not q and not self._exhausted:
+            try:
+                ref = next(self._stream)
+            except StopIteration:
+                self._exhausted = True
+                break
+            self._queues[self._rr].append(ref)
+            self._rr = (self._rr + 1) % self._n
+        if q:
+            import ray_tpu
+
+            return ray_tpu.get(q.pop(0))
+        return None
+
+    def reset(self):
+        """Start a fresh epoch (re-runs the plan)."""
+        self._stream = None
+        self._exhausted = False
+        self._queues = [[] for _ in range(self._n)]
+        self._rr = 0
+
+
+class DataIterator:
+    """Per-consumer iterator; picklable (ships an actor handle or a plan).
+
+    Reference: data/iterator.py — `get_dataset_shard` returns one of these
+    inside each train worker."""
+
+    def __init__(self, *, dataset: Any = None, coordinator: Any = None,
+                 split_idx: int = 0):
+        self._dataset = dataset
+        self._coordinator = coordinator
+        self._split_idx = split_idx
+
+    def _block_iter(self) -> Iterator[Block]:
+        import ray_tpu
+
+        if self._coordinator is not None:
+            while True:
+                block = ray_tpu.get(
+                    self._coordinator.next_block.remote(self._split_idx))
+                if block is None:
+                    return
+                yield block
+        else:
+            yield from self._dataset.iter_blocks()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     prefetch_batches: int = 1,
+                     drop_last: bool = False) -> Iterator[Block]:
+        leftover: Optional[Block] = None
+        for block in self._block_iter():
+            if leftover is not None and block_num_rows(leftover):
+                block = block_concat([leftover, block])
+                leftover = None
+            if batch_size is None:
+                yield block
+                continue
+            n = block_num_rows(block)
+            i = 0
+            while n - i >= batch_size:
+                yield block_slice(block, i, i + batch_size)
+                i += batch_size
+            if i < n:
+                leftover = block_slice(block, i, n)
+        if (leftover is not None and block_num_rows(leftover)
+                and not drop_last):
+            yield leftover
+
+    def iter_rows(self) -> Iterator[Any]:
+        from ray_tpu.data.block import block_to_items
+
+        for block in self._block_iter():
+            yield from block_to_items(block)
+
+    def materialize_all(self) -> List[Block]:
+        return list(self._block_iter())
+
+    def new_epoch(self) -> None:
+        if self._coordinator is not None and self._split_idx == 0:
+            import ray_tpu
+
+            ray_tpu.get(self._coordinator.reset.remote())

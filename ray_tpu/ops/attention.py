@@ -102,8 +102,8 @@ def block_attn_finish(l: jax.Array, o: jax.Array, dtype) -> jax.Array:
 # ---------------------------------------------------------------------------
 # Pallas TPU flash attention kernel
 # ---------------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, causal: bool, block_q: int, block_k: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
                   num_k_blocks: int):
     qi = pl.program_id(2)
     ki = pl.program_id(3)
@@ -115,12 +115,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)        # [block_q, d]
-        k = k_ref[0, 0].astype(jnp.float32)        # [block_k, d]
+        # Feed the MXU native-dtype operands (bf16 in, fp32 accumulate via
+        # preferred_element_type) — upcasting to f32 + HIGHEST precision would
+        # run the MXU in multi-pass mode and dominate the kernel time.
+        q = q_ref[0, 0]                            # [block_q, d]
+        k = k_ref[0, 0]                            # [block_k, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if causal:
             q_ids = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -133,11 +135,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         p = jnp.exp(s - m_new[:, None])
         l_scr[:, 0] = l_scr[:, 0] * alpha + p.sum(axis=-1)
         m_scr[:, 0] = m_new
-        v = v_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0]
         acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     if causal:
         # Skip fully-masked kv blocks (upper triangle).
@@ -151,6 +152,215 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finish():
         denom = jnp.maximum(l_scr[:, 0], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+        # Row logsumexp — the residual the backward kernels rebuild p from.
+        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(denom))[:, None]
+
+
+def _flash_fwd_core(qt, kt, vt, cfg):
+    """Forward on [B,H,S,D] layout. Returns (out, lse)."""
+    causal, scale, block_q, block_k, interpret = cfg
+    b, h, sq, d = qt.shape
+    skv = kt.shape[2]
+    num_k_blocks = skv // block_k
+    grid = (b, h, sq // block_q, num_k_blocks)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_k_blocks=num_k_blocks)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((block_q, 1)),
+            _vmem((block_q, 1)),
+            _vmem((block_q, d)),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out, lse
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     dq_scr, *, scale, causal, block_q, block_k,
+                     num_k_blocks):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_ids <= q_ids, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0])
+        do = do_ref[0, 0]
+        # dp = dO @ V^T
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0, 0]) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                      block_q, block_k, num_q_blocks):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_ids = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_ids = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_ids <= q_ids, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0, 0])  # [bq, bk]
+        do = do_ref[0, 0]
+        pb = p.astype(do.dtype)
+        # dV += P^T @ dO
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta_ref[0, 0]) * scale).astype(q.dtype)
+        # dK += dS^T @ Q
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == num_q_blocks - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_core(qt, kt, vt, out, lse, dout, cfg):
+    causal, scale, block_q, block_k, interpret = cfg
+    b, h, sq, d = qt.shape
+    skv = kt.shape[2]
+    num_q_blocks = sq // block_q
+    num_k_blocks = skv // block_k
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [B,H,Sq,1]
+
+    qkv_spec = lambda which: {
+        "q": pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        "k": pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+    }[which]
+    row_spec = pl.BlockSpec((1, 1, block_q, 1),
+                            lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          num_k_blocks=num_k_blocks),
+        grid=(b, h, num_q_blocks, num_k_blocks),
+        in_specs=[qkv_spec("q"), qkv_spec("k"), qkv_spec("k"),
+                  qkv_spec("q"), row_spec, row_spec],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), qt.dtype),
+        scratch_shapes=[_vmem((block_q, d))],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(qt, kt, vt, dout, lse, delta)
+
+    # dk/dv: grid iterates q blocks sequentially per k block.
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki, qi: (bi, hi, ki, 0))
+    rspec = pl.BlockSpec((1, 1, block_q, 1),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          num_q_blocks=num_q_blocks),
+        grid=(b, h, num_k_blocks, num_q_blocks),
+        in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
+        out_specs=[kspec, kspec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, skv, d), kt.dtype),
+                   jax.ShapeDtypeStruct((b, h, skv, d), vt.dtype)],
+        scratch_shapes=[_vmem((block_k, d)), _vmem((block_k, d))],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(qt, kt, vt, dout, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_core(qt, kt, vt, cfg):
+    out, _ = _flash_fwd_core(qt, kt, vt, cfg)
+    return out
+
+
+def _flash_core_fwd(qt, kt, vt, cfg):
+    out, lse = _flash_fwd_core(qt, kt, vt, cfg)
+    return out, (qt, kt, vt, out, lse)
+
+
+def _flash_core_bwd(cfg, res, dout):
+    qt, kt, vt, out, lse = res
+    return _flash_bwd_core(qt, kt, vt, out, lse, dout, cfg)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 
 def flash_attention(
@@ -160,14 +370,23 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Pallas flash attention. q [B,Sq,H,D], k/v [B,Skv,Hkv,D] → [B,Sq,H,D].
 
-    Grid (B, H, q_blocks, k_blocks); k dimension is sequential ("arbitrary")
-    carrying running softmax stats in VMEM scratch.
+    Differentiable: forward saves per-row logsumexp, backward runs two Pallas
+    kernels (dq with k sequential; dk/dv with q sequential) — the
+    FlashAttention-2 recipe, O(S) memory. GQA expansion happens outside the
+    custom_vjp so XLA differentiates the repeat into a segment-sum.
+
+    Precision: MXU dots run at native input precision with f32 accumulation
+    (the standard TPU flash tradeoff). f32 inputs are truncated to bf16 on
+    the MXU; use attention_reference for full-f32 logits.
+
+    Grid (B, H, q_blocks, k_blocks); the trailing dimension is sequential
+    ("arbitrary") carrying running softmax stats in VMEM scratch.
     """
     if interpret is None:
         interpret = jax.default_backend() == "cpu"
@@ -175,41 +394,23 @@ def flash_attention(
     b, sq, h, d = q.shape
     skv = k.shape[1]
     k, v = _gqa_expand(k, v, h)
+    # Shrink blocks to divide the sequence (defaults are sized for long
+    # power-of-two sequences; a 1536-long sequence steps down to 512/…).
     block_q = min(block_q, sq)
     block_k = min(block_k, skv)
+    while block_q > 1 and sq % block_q:
+        block_q //= 2
+    while block_k > 1 and skv % block_k:
+        block_k //= 2
     if sq % block_q or skv % block_k:
         raise ValueError(f"seq lens ({sq},{skv}) must divide blocks "
                          f"({block_q},{block_k})")
-    num_k_blocks = skv // block_k
     # Layout [B, H, S, D] for clean 2D blocks.
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-
-    grid = (b, h, sq // block_q, num_k_blocks)
-    kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=num_k_blocks)
-
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-        scratch_shapes=[
-            _vmem((block_q, 1)),
-            _vmem((block_q, 1)),
-            _vmem((block_q, d)),
-        ],
-        compiler_params=_compiler_params(),
-        interpret=interpret,
-    )(qt, kt, vt)
+    cfg = (causal, scale, block_q, block_k, interpret)
+    out = _flash_core(qt, kt, vt, cfg)
     return out.transpose(0, 2, 1, 3)
 
 

@@ -40,6 +40,18 @@ class RayTpuConfig:
     # Idle workers kept warm per (language, runtime-env) key.
     idle_worker_pool_size: int = 2
     worker_start_timeout_s: float = 60.0
+    # Task submission pipelining: specs per batched push RPC, and batches in
+    # flight per leased worker (reference: the submitter keeps the worker's
+    # pipe full instead of one lock-step PushTask round trip at a time).
+    task_batch_size: int = 16
+    task_push_window: int = 4
+    # How long a drained lease lingers waiting for new work before the worker
+    # is returned (reference: lease caching in normal_task_submitter.h:44 —
+    # avoids a lease round trip per submission wave).
+    lease_linger_s: float = 0.2
+    # Threads executing normal tasks inside one worker process (tasks have no
+    # ordering contract; actor tasks keep their own per-group executors).
+    task_executor_threads: int = 4
 
     # --- control plane ---
     heartbeat_interval_s: float = 1.0
