@@ -1,0 +1,77 @@
+"""ray_tpu.tune — hyperparameter search (reference: python/ray/tune).
+
+Tuner/TuneConfig/schedulers (ASHA, PBT)/search spaces; function trainables
+use ray_tpu.tune.report(...) + get_checkpoint(), sharing the Train
+checkpoint format so Train jobs nest as Tune trials unchanged."""
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    TuneConfig,
+    TuneResult,
+    Tuner,
+    TuneRunConfig,
+)
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from inside a trial."""
+    from ray_tpu.tune.trial import get_session
+
+    s = get_session()
+    if s is None:
+        raise RuntimeError("tune.report() called outside a Tune trial")
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to restore from (set after PBT exploit / retry)."""
+    from ray_tpu.tune.trial import get_session
+
+    s = get_session()
+    return s.restored_checkpoint if s is not None else None
+
+
+def get_config() -> Dict[str, Any]:
+    from ray_tpu.tune.trial import get_session
+
+    s = get_session()
+    return dict(s.config) if s is not None else {}
+
+
+__all__ = [
+    "ASHAScheduler",
+    "Checkpoint",
+    "FIFOScheduler",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "TuneConfig",
+    "TuneResult",
+    "TuneRunConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_config",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "report",
+    "uniform",
+]

@@ -1,0 +1,162 @@
+"""Trial schedulers: FIFO, ASHA, PBT (reference: python/ray/tune/schedulers/
+async_hyperband.py `AsyncHyperBandScheduler`, pbt.py:221
+`PopulationBasedTraining`).
+
+Redesign: schedulers are pure decision objects — the controller owns all
+actor lifecycle. A decision is one of CONTINUE / STOP / EXPLOIT(src_trial,
+new_config), which keeps PBT's exploit step explicit instead of hiding a
+checkpoint swap inside the scheduler."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+@dataclasses.dataclass
+class Exploit:
+    source_trial_id: str
+    new_config: Dict[str, Any]
+
+
+class FIFOScheduler:
+    def on_result(self, trial, result: Dict[str, Any], trials) -> Any:
+        return CONTINUE
+
+
+class ASHAScheduler:
+    """Asynchronous successive halving (reference:
+    tune/schedulers/async_hyperband.py).
+
+    Rungs at max(1, grace_period) * reduction_factor**k; a trial reaching a
+    rung continues only if its metric is in the top 1/reduction_factor of
+    completed records at that rung."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 grace_period: int = 1, reduction_factor: int = 4,
+                 max_t: int = 100, time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.grace = max(1, grace_period)
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self.time_attr = time_attr
+        # rung -> {trial_id: value at the time the trial reached the rung}.
+        # A trial records once per rung; the continue/stop decision happens
+        # at recording time against everyone recorded so far (async SHA).
+        self._rungs: Dict[int, Dict[str, float]] = {}
+        rung = self.grace
+        while rung < max_t:
+            self._rungs[rung] = {}
+            rung *= self.rf
+
+    def on_result(self, trial, result: Dict[str, Any], trials) -> Any:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        rung = self._current_rung(t, trial.trial_id)
+        if rung is None:
+            return CONTINUE
+        recorded = self._rungs[rung]
+        recorded[trial.trial_id] = float(value)
+        if len(recorded) < self.rf:
+            return CONTINUE  # not enough evidence yet
+        cutoff = self._cutoff(list(recorded.values()))
+        good = (value >= cutoff) if self.mode == "max" else (value <= cutoff)
+        return CONTINUE if good else STOP
+
+    def _current_rung(self, t: int, trial_id: str) -> Optional[int]:
+        """Highest rung ≤ t the trial has not recorded at yet."""
+        best = None
+        for rung, recorded in self._rungs.items():
+            if t >= rung and trial_id not in recorded and (
+                    best is None or rung > best):
+                best = rung
+        return best
+
+    def _cutoff(self, values: List[float]) -> float:
+        ordered = sorted(values, reverse=(self.mode == "max"))
+        k = max(0, math.ceil(len(ordered) / self.rf) - 1)
+        return ordered[k]
+
+
+class PopulationBasedTraining:
+    """PBT (reference: tune/schedulers/pbt.py:221): every
+    perturbation_interval reports, bottom-quantile trials exploit a
+    top-quantile trial's checkpoint and perturbed hyperparameters."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 perturbation_interval: int = 1,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 time_attr: str = "training_iteration",
+                 seed: Optional[int] = None):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.interval = max(1, perturbation_interval)
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+
+    def on_result(self, trial, result: Dict[str, Any], trials) -> Any:
+        t = int(result.get(self.time_attr, 0))
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+
+        scored = [(tr, tr.last_result.get(self.metric))
+                  for tr in trials if tr.last_result.get(self.metric)
+                  is not None]
+        if len(scored) < 2:
+            return CONTINUE
+        rev = self.mode == "max"
+        scored.sort(key=lambda p: p[1], reverse=rev)
+        k = max(1, int(len(scored) * self.quantile))
+        top = [tr for tr, _ in scored[:k]]
+        bottom_ids = {tr.trial_id for tr, _ in scored[-k:]}
+        if trial.trial_id not in bottom_ids or trial in top:
+            return CONTINUE
+        src = self._rng.choice(top)
+        if src.trial_id == trial.trial_id:
+            return CONTINUE
+        return Exploit(src.trial_id, self._perturb(src.config))
+
+    def _perturb(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_p or key not in out:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self._rng)
+                elif isinstance(spec, (list, tuple)):
+                    out[key] = self._rng.choice(list(spec))
+                elif callable(spec):
+                    out[key] = spec()
+                continue
+            cur = out[key]
+            if isinstance(cur, (int, float)) and not isinstance(cur, bool):
+                factor = self._rng.choice([0.8, 1.2])
+                out[key] = type(cur)(cur * factor) if isinstance(cur, float) \
+                    else max(1, int(cur * factor))
+            elif isinstance(spec, (list, tuple)):
+                out[key] = self._rng.choice(list(spec))
+        return out

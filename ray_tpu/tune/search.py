@@ -1,0 +1,75 @@
+"""Search spaces + basic search generation (reference: python/ray/tune/
+search/ — sample.py domains, basic_variant.py grid/random generation)."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass
+class Domain:
+    sampler: Callable[[random.Random], Any]
+
+    def sample(self, rng: random.Random) -> Any:
+        return self.sampler(rng)
+
+
+def choice(options: Sequence[Any]) -> Domain:
+    opts = list(options)
+    return Domain(lambda rng: rng.choice(opts))
+
+
+def uniform(low: float, high: float) -> Domain:
+    return Domain(lambda rng: rng.uniform(low, high))
+
+
+def loguniform(low: float, high: float) -> Domain:
+    import math
+
+    lo, hi = math.log(low), math.log(high)
+    return Domain(lambda rng: math.exp(rng.uniform(lo, hi)))
+
+
+def randint(low: int, high: int) -> Domain:
+    return Domain(lambda rng: rng.randrange(low, high))
+
+
+def quniform(low: float, high: float, q: float) -> Domain:
+    return Domain(lambda rng: round(rng.uniform(low, high) / q) * q)
+
+
+@dataclasses.dataclass
+class GridSearch:
+    values: List[Any]
+
+
+def grid_search(values: Sequence[Any]) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def generate_configs(param_space: Dict[str, Any], num_samples: int,
+                     seed: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Expand grid axes (cartesian) × num_samples random draws of the rest
+    (reference: basic_variant.py)."""
+    rng = random.Random(seed)
+    grid_axes = {k: v.values for k, v in param_space.items()
+                 if isinstance(v, GridSearch)}
+    grids: List[Dict[str, Any]] = [{}]
+    for key, values in grid_axes.items():
+        grids = [dict(g, **{key: v}) for g in grids for v in values]
+
+    configs: List[Dict[str, Any]] = []
+    for _ in range(max(1, num_samples)):
+        for g in grids:
+            cfg = dict(g)
+            for k, v in param_space.items():
+                if k in cfg:
+                    continue
+                if isinstance(v, Domain):
+                    cfg[k] = v.sample(rng)
+                else:
+                    cfg[k] = v
+            configs.append(cfg)
+    return configs

@@ -1,0 +1,142 @@
+"""Trial state + the actor that runs a function trainable.
+
+Reference: python/ray/tune/experiment/trial.py (`Trial`),
+tune/trainable/function_trainable.py (the session thread + report queue).
+Redesign: one generic _TrialActor hosts the user function on a thread and
+buffers (metrics, checkpoint) reports — the controller polls, mirroring the
+Train worker-group protocol so both libraries share one mental model."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    status: str = PENDING
+    last_result: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    checkpoint_path: Optional[str] = None
+    error: Optional[str] = None
+    iteration: int = 0
+    restarts: int = 0
+    actor: Any = None
+
+    def to_state(self) -> Dict[str, Any]:
+        return {
+            "trial_id": self.trial_id,
+            "config": self.config,
+            "status": (TERMINATED if self.status == TERMINATED else
+                       self.status if self.status == ERROR else PENDING),
+            "last_result": self.last_result,
+            "metrics_history": self.metrics_history,
+            "checkpoint_path": self.checkpoint_path,
+            "error": self.error,
+            "iteration": self.iteration,
+        }
+
+    @staticmethod
+    def from_state(state: Dict[str, Any]) -> "Trial":
+        t = Trial(state["trial_id"], state["config"])
+        t.status = state["status"]
+        t.last_result = state.get("last_result", {})
+        t.metrics_history = state.get("metrics_history", [])
+        t.checkpoint_path = state.get("checkpoint_path")
+        t.error = state.get("error")
+        t.iteration = state.get("iteration", 0)
+        return t
+
+
+class _TuneSession:
+    """Per-trial session: tune.report()/get_checkpoint() inside the fn."""
+
+    def __init__(self, trial_id: str, config: Dict[str, Any],
+                 checkpoint: Optional[Checkpoint], staging_dir: str):
+        self.trial_id = trial_id
+        self.config = config
+        self.restored_checkpoint = checkpoint
+        self.staging_dir = staging_dir
+        self.lock = threading.Lock()
+        self.results: List[Dict[str, Any]] = []
+        self.finished = False
+        self.error: Optional[str] = None
+        self.error_tb: Optional[str] = None
+        self._seq = 0
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        item: Dict[str, Any] = {"metrics": dict(metrics)}
+        if checkpoint is not None:
+            self._seq += 1
+            staged = os.path.join(self.staging_dir,
+                                  f"{self.trial_id}-{self._seq:06d}")
+            checkpoint.to_directory(staged)
+            item["checkpoint_path"] = staged
+        with self.lock:
+            self.results.append(item)
+
+
+_session: Optional[_TuneSession] = None
+
+
+def get_session() -> Optional[_TuneSession]:
+    return _session
+
+
+class _TrialActor:
+    """Actor hosting one trial's function trainable."""
+
+    def __init__(self, trial_id: str, staging_dir: str):
+        self.trial_id = trial_id
+        self.staging_dir = staging_dir
+        os.makedirs(staging_dir, exist_ok=True)
+        self._thread: Optional[threading.Thread] = None
+        self._session: Optional[_TuneSession] = None
+
+    def run(self, fn, config: Dict[str, Any],
+            checkpoint_path: Optional[str]) -> None:
+        ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        sess = _TuneSession(self.trial_id, config, ckpt, self.staging_dir)
+        self._session = sess
+
+        def target():
+            global _session
+            _session = sess
+            try:
+                fn(config)
+            except BaseException as e:  # noqa: BLE001
+                sess.error = f"{type(e).__name__}: {e}"
+                sess.error_tb = traceback.format_exc()
+            finally:
+                sess.finished = True
+
+        self._thread = threading.Thread(target=target, daemon=True,
+                                        name=f"trial-{self.trial_id}")
+        self._thread.start()
+
+    def poll(self) -> Dict[str, Any]:
+        sess = self._session
+        if sess is None:
+            return {"results": [], "finished": False, "error": None}
+        with sess.lock:
+            results, sess.results = sess.results, []
+        return {
+            "results": results,
+            "finished": sess.finished,
+            "error": sess.error,
+            "traceback": sess.error_tb,
+        }

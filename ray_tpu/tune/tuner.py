@@ -1,0 +1,295 @@
+"""Tuner + controller loop (reference: python/ray/tune/tuner.py:43,
+tune/execution/tune_controller.py:68).
+
+Redesign: a synchronous driver-side controller (the reference's is an actor
+event loop juggling futures; here the RPC plane is already async under the
+sync API, so a poll loop is simpler and equally concurrent — trials run in
+actors either way). Trial gangs get their resources via actor options; TPU
+trials gang-schedule via placement groups exactly like Train worker groups.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.tune.schedulers import CONTINUE, STOP, Exploit, FIFOScheduler
+from ray_tpu.tune.search import generate_configs
+from ray_tpu.tune.trial import (
+    ERROR,
+    PENDING,
+    RUNNING,
+    TERMINATED,
+    Trial,
+    _TrialActor,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: int = 8
+    metric: Optional[str] = None
+    mode: str = "max"
+    scheduler: Any = None
+    seed: Optional[int] = None
+    max_failures_per_trial: int = 0
+
+
+@dataclasses.dataclass
+class TuneRunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    resources_per_trial: Optional[Dict[str, float]] = None
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.expanduser("~/ray_tpu_results")
+        name = self.name or f"tune_{uuid.uuid4().hex[:8]}"
+        return os.path.join(base, name)
+
+
+@dataclasses.dataclass
+class TuneResult:
+    trial_id: str
+    metrics: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    checkpoint: Optional[Checkpoint]
+    config: Dict[str, Any]
+    error: Optional[str]
+
+
+class ResultGrid:
+    def __init__(self, results: List[TuneResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self) -> int:
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i: int) -> TuneResult:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[str]:
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TuneResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results
+                  if r.metrics.get(metric) is not None]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        sign = 1 if mode == "max" else -1
+        return max(scored, key=lambda r: sign * r.metrics[metric])
+
+
+class Tuner:
+    """`Tuner(trainable, param_space=..., tune_config=...).fit()`."""
+
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[TuneRunConfig] = None,
+                 _restore_path: Optional[str] = None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or TuneRunConfig()
+        self._restore_path = _restore_path
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable) -> "Tuner":
+        """Resume an interrupted experiment from its storage dir
+        (reference: tune/execution/experiment_state.py)."""
+        rc = TuneRunConfig(storage_path=os.path.dirname(path),
+                           name=os.path.basename(path))
+        return cls(trainable, run_config=rc, _restore_path=path)
+
+    def fit(self) -> ResultGrid:
+        storage = (self._restore_path
+                   or self.run_config.resolved_storage_path())
+        os.makedirs(storage, exist_ok=True)
+        controller = _TuneController(
+            self.trainable, self.param_space, self.tune_config,
+            self.run_config, storage,
+            restore=self._restore_path is not None)
+        return controller.run()
+
+
+class _TuneController:
+    """Drives trials to completion (reference: tune_controller.py:68)."""
+
+    def __init__(self, trainable, param_space, tune_cfg: TuneConfig,
+                 run_cfg: TuneRunConfig, storage: str, restore: bool):
+        self.trainable = trainable
+        self.tune_cfg = tune_cfg
+        self.run_cfg = run_cfg
+        self.storage = storage
+        self.scheduler = tune_cfg.scheduler or FIFOScheduler()
+        self.state_path = os.path.join(storage, "experiment_state.json")
+        if restore and os.path.exists(self.state_path):
+            with open(self.state_path) as f:
+                state = json.load(f)
+            self.trials = [Trial.from_state(s) for s in state["trials"]]
+            for t in self.trials:
+                # Unfinished trials restart from their latest checkpoint.
+                if t.status not in (TERMINATED, ERROR):
+                    t.status = PENDING
+        else:
+            configs = generate_configs(param_space, tune_cfg.num_samples,
+                                       tune_cfg.seed)
+            self.trials = [
+                Trial(trial_id=f"trial_{i:04d}", config=cfg)
+                for i, cfg in enumerate(configs)
+            ]
+            self._persist()
+
+    # ------------------------------------------------------------------
+    def run(self) -> ResultGrid:
+        try:
+            while self._unfinished():
+                self._start_pending()
+                self._poll_running()
+                self._persist()
+                time.sleep(0.05)
+        finally:
+            for t in self.trials:
+                self._stop_actor(t)
+            self._persist()
+        results = [
+            TuneResult(
+                trial_id=t.trial_id, metrics=t.last_result,
+                metrics_history=t.metrics_history,
+                checkpoint=(Checkpoint(t.checkpoint_path)
+                            if t.checkpoint_path else None),
+                config=t.config, error=t.error)
+            for t in self.trials
+        ]
+        return ResultGrid(results, self.tune_cfg.metric, self.tune_cfg.mode)
+
+    def _unfinished(self) -> List[Trial]:
+        return [t for t in self.trials if t.status in (PENDING, RUNNING)]
+
+    def _running(self) -> List[Trial]:
+        return [t for t in self.trials if t.status == RUNNING]
+
+    def _start_pending(self) -> None:
+        cap = max(1, self.tune_cfg.max_concurrent_trials)
+        for t in self.trials:
+            if len(self._running()) >= cap:
+                break
+            if t.status != PENDING:
+                continue
+            self._start_trial(t)
+
+    def _start_trial(self, t: Trial) -> None:
+        res = self.run_cfg.resources_per_trial or {"CPU": 1.0}
+        Actor = ray_tpu.remote(_TrialActor)
+        staging = os.path.join(self.storage, ".staging")
+        t.actor = Actor.options(
+            num_cpus=res.get("CPU", 1.0),
+            num_tpus=res.get("TPU", 0.0) or None,
+        ).remote(t.trial_id, staging)
+        ray_tpu.get(t.actor.run.remote(self.trainable, t.config,
+                                       t.checkpoint_path), timeout=120)
+        t.status = RUNNING
+
+    def _stop_actor(self, t: Trial) -> None:
+        if t.actor is not None:
+            try:
+                ray_tpu.kill(t.actor)
+            except Exception:
+                pass
+            t.actor = None
+
+    def _poll_running(self) -> None:
+        for t in self._running():
+            try:
+                poll = ray_tpu.get(t.actor.poll.remote(), timeout=60)
+            except Exception as e:
+                self._on_trial_failed(t, f"trial actor died: {e}")
+                continue
+            for item in poll["results"]:
+                self._on_result(t, item)
+                if t.status != RUNNING:
+                    break
+            if t.status != RUNNING:
+                continue
+            if poll["error"]:
+                self._on_trial_failed(
+                    t, f"{poll['error']}\n{poll.get('traceback') or ''}")
+            elif poll["finished"]:
+                t.status = TERMINATED
+                self._stop_actor(t)
+
+    def _on_result(self, t: Trial, item: Dict[str, Any]) -> None:
+        metrics = dict(item["metrics"])
+        t.iteration += 1
+        metrics.setdefault("training_iteration", t.iteration)
+        t.last_result = metrics
+        t.metrics_history.append(metrics)
+        if item.get("checkpoint_path"):
+            dest = os.path.join(self.storage, t.trial_id,
+                                f"checkpoint_{t.iteration:06d}")
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            if os.path.isdir(dest):
+                shutil.rmtree(dest, ignore_errors=True)
+            shutil.move(item["checkpoint_path"], dest)
+            t.checkpoint_path = dest
+        decision = self.scheduler.on_result(t, metrics, self.trials)
+        if decision == STOP:
+            logger.info("scheduler stopped %s at iter %d", t.trial_id,
+                        t.iteration)
+            t.status = TERMINATED
+            self._stop_actor(t)
+        elif isinstance(decision, Exploit):
+            self._exploit(t, decision)
+
+    def _exploit(self, t: Trial, decision: Exploit) -> None:
+        src = next((x for x in self.trials
+                    if x.trial_id == decision.source_trial_id), None)
+        if src is None or src.checkpoint_path is None:
+            return
+        logger.info("PBT: %s exploits %s (new config %s)", t.trial_id,
+                    src.trial_id, decision.new_config)
+        self._stop_actor(t)
+        t.config = dict(decision.new_config)
+        t.checkpoint_path = src.checkpoint_path
+        t.restarts += 1
+        t.status = PENDING  # restarted by the next _start_pending sweep
+
+    def _on_trial_failed(self, t: Trial, error: str) -> None:
+        self._stop_actor(t)
+        if t.restarts < self.tune_cfg.max_failures_per_trial:
+            t.restarts += 1
+            t.status = PENDING
+            logger.warning("trial %s failed (%s); retrying from %s",
+                           t.trial_id, error.splitlines()[0] if error else "?",
+                           t.checkpoint_path)
+        else:
+            t.status = ERROR
+            t.error = error
+
+    def _persist(self) -> None:
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"trials": [t.to_state() for t in self.trials]}, f,
+                      default=str)
+        os.replace(tmp, self.state_path)
