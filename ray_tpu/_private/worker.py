@@ -529,6 +529,10 @@ class Worker:
         self._actor_is_async = False
         self._running_tasks: Dict[TaskID, Any] = {}
         self._cancelled_tasks: set = set()
+        # Streaming generators (owner side): task_id -> GeneratorState.
+        self._generators: Dict[TaskID, Any] = {}
+        # Executor side: cached clients for streaming items back to owners.
+        self._gen_clients: Dict[Tuple[str, int], RpcClient] = {}
         self.connected = False
         self._shutdown = False
         # The task currently executing in this process (execution context).
@@ -609,6 +613,7 @@ class Worker:
         s = self.server
         s.register("push_task", self._rpc_push_task)
         s.register("push_task_batch", self._rpc_push_task_batch)
+        s.register("report_generator_item", self._rpc_report_generator_item)
         s.register("create_actor", self._rpc_create_actor)
         s.register("push_actor_task", self._rpc_push_actor_task)
         s.register("push_actor_task_batch", self._rpc_push_actor_task_batch)
@@ -889,7 +894,15 @@ class Worker:
             runtime_env=runtime_env,
         )
         return_ids = self.task_manager.add_pending(spec)
-        refs = []
+        if num_returns == -1:
+            from ray_tpu._private.generators import ObjectRefGenerator
+
+            self.loop.call_soon_threadsafe(
+                lambda: self._gen_state(spec.task_id))
+            refs = [ObjectRefGenerator(spec.task_id, self)]
+            return_ids = []
+        else:
+            refs = []
         for oid in return_ids:
             self.ref_counter.add_owned_ref(oid)
             refs.append(ObjectRef(oid, owner_address=self.address))
@@ -1097,6 +1110,14 @@ class Worker:
                 spec.task_id,
                 ser.serialize_error(TaskCancelledError(str(spec.task_id))))
             return
+        if "generator_count" in reply:
+            # Streaming task finished: the items were delivered via
+            # report_generator_item; here we only learn the final length.
+            st = self._gen_state(spec.task_id)
+            st.count = reply["generator_count"]
+            st.pulse()
+            self.task_manager.complete(spec.task_id, [])
+            return
         results = []
         for item in reply["results"]:
             kind = item[0]
@@ -1205,7 +1226,15 @@ class Worker:
             concurrency_group=concurrency_group,
         )
         return_ids = self.task_manager.add_pending(spec)
-        refs = []
+        if num_returns == -1:
+            from ray_tpu._private.generators import ObjectRefGenerator
+
+            self.loop.call_soon_threadsafe(
+                lambda: self._gen_state(spec.task_id))
+            refs = [ObjectRefGenerator(spec.task_id, self)]
+            return_ids = []
+        else:
+            refs = []
         for oid in return_ids:
             self.ref_counter.add_owned_ref(oid)
             refs.append(ObjectRef(oid, owner_address=self.address))
@@ -1363,6 +1392,8 @@ class Worker:
             args, kwargs = self._resolve_spec_args_sync(spec)
             self._current_task_id = spec.task_id
             result = method(*args, **kwargs)
+            if spec.num_returns == -1:
+                return self._stream_generator(spec, iter(result))
             return self._with_borrows(spec, {
                 "results": self._pack_results(spec, result)})
         except BaseException as e:  # noqa: BLE001
@@ -1379,6 +1410,8 @@ class Worker:
             args, kwargs = self._resolve_spec_args_sync(spec)
             self._current_task_id = spec.task_id
             result = fn(*args, **kwargs)
+            if spec.num_returns == -1:
+                return self._stream_generator(spec, iter(result))
             return self._with_borrows(spec, {
                 "results": self._pack_results(spec, result)})
         except BaseException as e:  # noqa: BLE001
@@ -1452,6 +1485,106 @@ class Worker:
                 out.append(("inline", obj.metadata,
                             ser.wire_buffers(obj.buffers)))
         return out
+
+    # ------------------------------------------------------------------
+    # Streaming generators (reference: ReportGeneratorItemReturns,
+    # task_manager.h:168; see _private/generators.py for the protocol)
+    # ------------------------------------------------------------------
+    def _gen_state(self, task_id: TaskID):
+        from ray_tpu._private.generators import GeneratorState
+
+        st = self._generators.get(task_id)
+        if st is None:
+            st = GeneratorState()
+            self._generators[task_id] = st
+        return st
+
+    async def _rpc_report_generator_item(
+            self, task_id: bytes, index: Optional[int] = None,
+            item: Optional[Tuple] = None,
+            count: Optional[int] = None) -> Dict[str, Any]:
+        """Owner side: store one streamed item (or just answer a
+        backpressure probe when item is None)."""
+        tid = TaskID(task_id)
+        st = self._gen_state(tid)
+        if item is not None and index is not None:
+            oid = ObjectID.for_task_return(tid, index)
+            kind = item[0]
+            if kind == "inline":
+                self.memory_store.put(
+                    oid, ser.SerializedObject(item[1], item[2], []))
+            elif kind == "shm":
+                self.memory_store.put(oid, ShmMarker(item[1]))
+            elif kind == "error":
+                self.memory_store.put(oid, ser.SerializedObject(
+                    ser.METADATA_ERROR, [item[1]], []))
+            self.ref_counter.add_owned_ref(oid)
+            st.reported = max(st.reported, index + 1)
+        if count is not None:
+            st.count = count
+        st.pulse()
+        return {"unconsumed": st.reported - st.consumed}
+
+    async def gen_next(self, task_id: TaskID,
+                       idx: int) -> Optional[ObjectID]:
+        """Owner side: wait until item idx exists (returns its ObjectID) or
+        the stream is known to have ended before idx (returns None)."""
+        st = self._gen_state(task_id)
+        while True:
+            if idx < st.reported:
+                st.consumed = max(st.consumed, idx + 1)
+                return ObjectID.for_task_return(task_id, idx)
+            if st.count is not None and idx >= st.count:
+                return None
+            await st.wait()
+
+    def _stream_generator(self, spec: TaskSpec, gen) -> Dict[str, Any]:
+        """Executor side: ship each yielded value to the owner as its own
+        object. Runs on the task executor thread; every report is a blocking
+        RPC (transport backpressure) plus a pause while the owner holds too
+        many unconsumed items."""
+        cfg = get_config()
+        owner = tuple(spec.owner_address)
+        idx = 0
+        try:
+            for value in gen:
+                obj = ser.serialize(value)
+                if obj.total_bytes() > cfg.max_inline_object_size:
+                    oid = ObjectID.for_task_return(spec.task_id, idx)
+                    self.shm.put_serialized(oid, obj)
+                    item: Tuple = ("shm", self.node_id.binary())
+                else:
+                    item = ("inline", obj.metadata,
+                            ser.wire_buffers(obj.buffers))
+                reply = self._send_gen_item(owner, spec.task_id, idx, item)
+                idx += 1
+                while (reply is not None and reply.get("unconsumed", 0)
+                        > cfg.generator_backpressure_num_objects):
+                    time.sleep(0.02)
+                    reply = self._send_gen_item(owner, spec.task_id, None,
+                                                None)
+        except BaseException as e:  # noqa: BLE001
+            err = self._error_result(e)
+            self._send_gen_item(owner, spec.task_id, idx, err)
+            idx += 1
+        return {"results": [], "generator_count": idx}
+
+    def _send_gen_item(self, owner: Tuple[str, int], task_id: TaskID,
+                       index: Optional[int], item: Optional[Tuple]):
+        async def _send():
+            client = self._gen_clients.get(owner)
+            if client is None:
+                client = RpcClient(*owner, name="gen-report")
+                self._gen_clients[owner] = client
+            return await client.call(
+                "report_generator_item", task_id=task_id.binary(),
+                index=index, item=item, timeout=600.0)
+
+        try:
+            return asyncio.run_coroutine_threadsafe(
+                _send(), self.loop).result(timeout=620)
+        except Exception:
+            return None  # owner gone: keep draining the generator cheaply
 
     def _error_result(self, exc: BaseException) -> Tuple:
         tb = traceback.format_exc()

@@ -22,15 +22,18 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         w = worker_mod.global_worker()
+        num_returns = self._num_returns
+        if num_returns == "dynamic":
+            num_returns = -1
         refs = w.submit_actor_task(
             self._handle._actor_id,
             self._method_name,
             args,
             kwargs,
-            num_returns=self._num_returns,
+            num_returns=num_returns,
             max_task_retries=self._handle._max_task_retries,
         )
-        if self._num_returns == 1:
+        if num_returns in (1, -1):
             return refs[0]
         return refs
 

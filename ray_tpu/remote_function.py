@@ -34,6 +34,8 @@ class RemoteFunction:
         if num_tpus:
             resources["TPU"] = float(num_tpus)
         num_returns = opts.get("num_returns", 1)
+        if num_returns == "dynamic":
+            num_returns = -1  # streaming generator (see _private/generators)
         from ray_tpu.util.scheduling_strategies import to_internal
 
         refs = w.submit_task(
@@ -48,7 +50,7 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
             function_name=self._fn.__name__,
         )
-        if num_returns == 1:
+        if num_returns in (1, -1):
             return refs[0]
         return refs
 

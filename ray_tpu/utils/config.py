@@ -52,6 +52,10 @@ class RayTpuConfig:
     # Threads executing normal tasks inside one worker process (tasks have no
     # ordering contract; actor tasks keep their own per-group executors).
     task_executor_threads: int = 4
+    # Streaming generators: executor pauses while the owner holds more than
+    # this many unconsumed items (reference:
+    # _generator_backpressure_num_objects).
+    generator_backpressure_num_objects: int = 16
 
     # --- control plane ---
     heartbeat_interval_s: float = 1.0
