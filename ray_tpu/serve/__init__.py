@@ -1,0 +1,204 @@
+"""ray_tpu.serve — model serving (reference: python/ray/serve).
+
+API surface: @serve.deployment, Deployment.bind, serve.run/start/shutdown,
+DeploymentHandle (pow-2 routing, streaming), an HTTP ingress proxy, and the
+controller/reconciler. The LLM serving engine (ray_tpu.llm) builds its
+deployments on this, mirroring how ray.llm builds on ray.serve."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.serve._common import CONTROLLER_NAME
+from ray_tpu.serve._handle import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentResponseGenerator,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class Application:
+    """A bound deployment graph node (reference: serve.Application)."""
+
+    deployment: "Deployment"
+    args: Tuple
+    kwargs: Dict
+
+
+class Deployment:
+    def __init__(self, ctor: Callable, name: str,
+                 num_replicas: int = 1,
+                 ray_actor_options: Optional[Dict[str, Any]] = None,
+                 max_ongoing_requests: int = 16,
+                 user_config: Optional[Dict[str, Any]] = None,
+                 route_prefix: Optional[str] = None):
+        self._ctor = ctor
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = ray_actor_options or {}
+        self.max_ongoing_requests = max_ongoing_requests
+        self.user_config = user_config
+        self.route_prefix = route_prefix
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = dict(
+            name=self.name, num_replicas=self.num_replicas,
+            ray_actor_options=self.ray_actor_options,
+            max_ongoing_requests=self.max_ongoing_requests,
+            user_config=self.user_config, route_prefix=self.route_prefix)
+        cfg.update(overrides)
+        return Deployment(self._ctor, **cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+
+def deployment(cls_or_fn=None, **config):
+    """@serve.deployment decorator (reference: serve/api.py)."""
+
+    def wrap(target):
+        name = config.pop("name", None) or getattr(
+            target, "__name__", "deployment")
+        return Deployment(target, name=name, **config)
+
+    if cls_or_fn is not None:
+        return wrap(cls_or_fn)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle
+# ---------------------------------------------------------------------------
+def start(http_port: int = 0, _with_http: bool = True):
+    """Ensure the controller (and optionally the HTTP proxy) are running."""
+    from ray_tpu.serve._controller import ServeController
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        Controller = ray_tpu.remote(ServeController)
+        controller = Controller.options(
+            name=CONTROLLER_NAME, max_concurrency=16, num_cpus=0.5,
+        ).remote()
+        ray_tpu.get(controller.start_loops.remote(), timeout=60)
+        if _with_http:
+            from ray_tpu.serve._proxy import ProxyActor
+
+            Proxy = ray_tpu.remote(ProxyActor)
+            proxy = Proxy.options(name="SERVE_PROXY", max_concurrency=64,
+                                  num_cpus=0.5).remote(http_port)
+            port = ray_tpu.get(proxy.start.remote(), timeout=60)
+            ray_tpu.get(controller.set_http_port.remote(port), timeout=30)
+    return controller
+
+
+def run(target: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/",
+        _blocking: bool = False) -> DeploymentHandle:
+    """Deploy an application (reference: serve/api.py:691 serve.run)."""
+    controller = start()
+    apps = _flatten(target)
+    # Deploy children first so parents find their handles live.
+    for app, is_root in reversed(apps):
+        dep = app.deployment
+        args = tuple(
+            DeploymentHandle(a.deployment.name)
+            if isinstance(a, Application) else a
+            for a in app.args)
+        kwargs = {
+            k: (DeploymentHandle(v.deployment.name)
+                if isinstance(v, Application) else v)
+            for k, v in app.kwargs.items()
+        }
+        prefix = route_prefix if is_root else dep.route_prefix
+        ray_tpu.get(controller.deploy.remote(
+            dep.name, cloudpickle.dumps(dep._ctor), args, kwargs,
+            dict(num_replicas=dep.num_replicas,
+                 ray_actor_options=dep.ray_actor_options,
+                 max_ongoing_requests=dep.max_ongoing_requests,
+                 user_config=dep.user_config,
+                 route_prefix=prefix)), timeout=120)
+    handle = DeploymentHandle(apps[0][0].deployment.name)
+    # Wait until the root deployment has live replicas (and release the
+    # probe's outstanding slot so routing stays unbiased).
+    rid, _ = handle._pick_replica()
+    handle._dec(rid)
+    return handle
+
+
+def _flatten(app: Application) -> List[Tuple[Application, bool]]:
+    out: List[Tuple[Application, bool]] = []
+
+    def walk(node: Application, is_root: bool):
+        out.append((node, is_root))
+        for a in list(node.args) + list(node.kwargs.values()):
+            if isinstance(a, Application):
+                walk(a, False)
+
+    walk(app, True)
+    return out
+
+
+def status() -> Dict[str, Any]:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.get_status.remote(), timeout=30)
+
+
+def http_port() -> Optional[int]:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.get_http_port.remote(), timeout=30)
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def delete(name: str) -> None:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete_deployment.remote(name), timeout=60)
+
+
+def shutdown() -> None:
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        return
+    try:
+        ray_tpu.get(controller.shutdown_all.remote(), timeout=60)
+    except Exception:
+        pass
+    for actor_name in ("SERVE_PROXY", CONTROLLER_NAME):
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(actor_name))
+        except Exception:
+            pass
+
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "DeploymentResponseGenerator",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "http_port",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

@@ -1,0 +1,28 @@
+"""Shared Serve structures (reference: python/ray/serve/config.py,
+serve/schema.py — trimmed to the dataclasses the runtime needs)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    name: str
+    num_replicas: int = 1
+    ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    max_ongoing_requests: int = 16
+    route_prefix: Optional[str] = None
+    version: int = 0
+    user_config: Optional[Dict[str, Any]] = None
+    health_check_period_s: float = 2.0
+
+
+@dataclasses.dataclass
+class ReplicaInfo:
+    replica_id: str
+    actor: Any  # ActorHandle
+    healthy: bool = True

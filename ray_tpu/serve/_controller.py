@@ -1,0 +1,183 @@
+"""Serve controller actor (reference: serve/_private/controller.py:92 +
+deployment_state.py:1379 reconciler).
+
+Redesign: one actor holds the desired state (deployment configs) and
+reconciles actual replica actors toward it in a background thread. Methods
+are sync — they run on the actor's executor threads, where blocking
+runtime calls (actor creation, gets) are legal; an async controller would
+deadlock creating replicas from its own event loop. Instead of the
+reference's long-poll host, consumers poll `get_routing(version)` — the
+version check makes the poll cheap, and handle-side caching makes it rare."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.serve._common import DeploymentConfig, ReplicaInfo
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ServeController:
+    def __init__(self):
+        # name -> {config, ctor, args, kwargs}
+        self._deployments: Dict[str, Dict[str, Any]] = {}
+        self._replicas: Dict[str, List[ReplicaInfo]] = {}
+        self._version = 0
+        self._running = False
+        self._http_port: Optional[int] = None
+        self._lock = threading.RLock()
+
+    def start_loops(self) -> None:
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        threading.Thread(target=self._reconcile_thread, daemon=True,
+                         name="serve-reconcile").start()
+
+    # ------------------------------------------------------------------
+    # Deploy API
+    # ------------------------------------------------------------------
+    def deploy(self, name: str, serialized_ctor: bytes,
+               init_args: Tuple, init_kwargs: Dict,
+               config: Dict[str, Any]) -> None:
+        with self._lock:
+            cfg = DeploymentConfig(name=name, **config)
+            cfg.version = self._version + 1
+            self._deployments[name] = {
+                "config": cfg,
+                "ctor": serialized_ctor,
+                "args": init_args,
+                "kwargs": init_kwargs,
+            }
+            self._version += 1
+        self._reconcile_once()
+
+    def delete_deployment(self, name: str) -> None:
+        with self._lock:
+            self._deployments.pop(name, None)
+            victims = self._replicas.pop(name, [])
+            self._version += 1
+        for info in victims:
+            self._kill(info)
+
+    def shutdown_all(self) -> None:
+        with self._lock:
+            self._running = False
+            names = list(self._deployments)
+        for name in names:
+            self.delete_deployment(name)
+
+    # ------------------------------------------------------------------
+    # Discovery (handles + proxy)
+    # ------------------------------------------------------------------
+    def get_routing(self, known_version: int = -1
+                    ) -> Optional[Dict[str, Any]]:
+        """Replica handles + route prefixes, or None when unchanged."""
+        with self._lock:
+            if known_version == self._version:
+                return None
+            return {
+                "version": self._version,
+                "deployments": {
+                    name: {
+                        "replicas": [(i.replica_id, i.actor)
+                                     for i in self._replicas.get(name, [])
+                                     if i.healthy],
+                        "route_prefix": d["config"].route_prefix,
+                        "max_ongoing_requests":
+                            d["config"].max_ongoing_requests,
+                    }
+                    for name, d in self._deployments.items()
+                },
+            }
+
+    def get_status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                name: {
+                    "target": d["config"].num_replicas,
+                    "running": sum(1 for i in self._replicas.get(name, [])
+                                   if i.healthy),
+                    "version": d["config"].version,
+                }
+                for name, d in self._deployments.items()
+            }
+
+    def set_http_port(self, port: int) -> None:
+        self._http_port = port
+
+    def get_http_port(self) -> Optional[int]:
+        return self._http_port
+
+    # ------------------------------------------------------------------
+    # Reconciliation
+    # ------------------------------------------------------------------
+    def _reconcile_thread(self) -> None:
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+            try:
+                self._reconcile_once(health_check=True)
+            except Exception:
+                logger.exception("reconcile failed")
+            time.sleep(1.0)
+
+    def _reconcile_once(self, health_check: bool = False) -> None:
+        from ray_tpu.serve._replica import ReplicaActor
+
+        changed = False
+        with self._lock:
+            items = list(self._deployments.items())
+        for name, d in items:
+            cfg: DeploymentConfig = d["config"]
+            replicas = self._replicas.setdefault(name, [])
+            if health_check:
+                for info in list(replicas):
+                    try:
+                        ray_tpu.get(info.actor.check_health.remote(),
+                                    timeout=10)
+                    except Exception:
+                        logger.warning(
+                            "replica %s of %s unhealthy; replacing",
+                            info.replica_id, name)
+                        with self._lock:
+                            if info in replicas:
+                                replicas.remove(info)
+                        self._kill(info)
+                        changed = True
+            while len(replicas) < cfg.num_replicas:
+                rid = f"{name}#{uuid.uuid4().hex[:6]}"
+                Actor = ray_tpu.remote(ReplicaActor)
+                opts = dict(cfg.ray_actor_options)
+                actor = Actor.options(
+                    num_cpus=opts.get("num_cpus", 1.0),
+                    num_tpus=opts.get("num_tpus") or None,
+                    max_concurrency=max(1, cfg.max_ongoing_requests),
+                ).remote(d["ctor"], tuple(d["args"]), dict(d["kwargs"]),
+                         cfg.user_config)
+                with self._lock:
+                    replicas.append(ReplicaInfo(rid, actor))
+                changed = True
+                logger.info("started replica %s for %s", rid, name)
+            while len(replicas) > cfg.num_replicas:
+                with self._lock:
+                    info = replicas.pop()
+                self._kill(info)
+                changed = True
+        if changed:
+            with self._lock:
+                self._version += 1
+
+    def _kill(self, info: ReplicaInfo) -> None:
+        try:
+            ray_tpu.kill(info.actor)
+        except Exception:
+            pass

@@ -1,0 +1,168 @@
+"""DeploymentHandle: the client-side router.
+
+Reference: serve/handle.py:639 (`DeploymentHandle`), _private/router.py:341,
+request_router/pow_2_router.py (power-of-two-choices replica picking).
+Redesign: routing state lives in the handle itself — it caches the
+controller's routing table by version and tracks its own outstanding count
+per replica; two random replicas are compared by load per request."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve._common import CONTROLLER_NAME
+
+_ROUTING_TTL_S = 2.0
+
+
+class _RouterCache:
+    def __init__(self):
+        self.version = -1
+        self.deployments: Dict[str, Any] = {}
+        self.fetched_at = 0.0
+        self.outstanding: Dict[str, int] = {}
+        self.lock = threading.Lock()
+
+
+class DeploymentResponse:
+    """Future-like wrapper over the underlying ObjectRef(s)."""
+
+    def __init__(self, ref, handle: "DeploymentHandle", replica_id: str):
+        self._ref = ref
+        self._handle = handle
+        self._replica_id = replica_id
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._finish()
+
+    def _finish(self):
+        if not self._done:
+            self._done = True
+            self._handle._dec(self._replica_id)
+
+    @property
+    def ref(self):
+        return self._ref
+
+    def __del__(self):
+        self._finish()
+
+
+class DeploymentResponseGenerator:
+    """Streaming response: iterate the replica's generator items."""
+
+    def __init__(self, gen, handle: "DeploymentHandle", replica_id: str):
+        self._gen = gen
+        self._handle = handle
+        self._replica_id = replica_id
+        self._done = False
+
+    def __iter__(self):
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref)
+        finally:
+            if not self._done:
+                self._done = True
+                self._handle._dec(self._replica_id)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: str = "__call__",
+                 stream: bool = False):
+        self.deployment_name = deployment_name
+        self._method_name = method_name
+        self._stream = stream
+        self._cache = _RouterCache()
+
+    # -- fluent API (reference: handle.options / method access) ----------
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name,
+            method_name if method_name is not None else self._method_name,
+            self._stream if stream is None else stream)
+        h._cache = self._cache  # share router state across variants
+        return h
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    # -- routing ---------------------------------------------------------
+    def _refresh(self, force: bool = False) -> None:
+        c = self._cache
+        now = time.monotonic()
+        if not force and now - c.fetched_at < _ROUTING_TTL_S and c.deployments:
+            return
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        routing = ray_tpu.get(
+            controller.get_routing.remote(c.version if not force else -1),
+            timeout=30)
+        with c.lock:
+            c.fetched_at = now
+            if routing is not None:
+                c.version = routing["version"]
+                c.deployments = routing["deployments"]
+
+    def _pick_replica(self):
+        c = self._cache
+        deadline = time.monotonic() + 30
+        while True:
+            self._refresh()
+            info = c.deployments.get(self.deployment_name)
+            replicas = info["replicas"] if info else []
+            if replicas:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment "
+                    f"{self.deployment_name!r}")
+            time.sleep(0.1)
+            self._refresh(force=True)
+        with c.lock:
+            if len(replicas) == 1:
+                rid, actor = replicas[0]
+            else:
+                # Power of two choices by local outstanding count.
+                a, b = random.sample(replicas, 2)
+                rid, actor = min(
+                    (a, b), key=lambda r: c.outstanding.get(r[0], 0))
+            c.outstanding[rid] = c.outstanding.get(rid, 0) + 1
+        return rid, actor
+
+    def _dec(self, replica_id: str) -> None:
+        c = self._cache
+        with c.lock:
+            n = c.outstanding.get(replica_id, 0)
+            if n > 0:
+                c.outstanding[replica_id] = n - 1
+
+    # -- invocation ------------------------------------------------------
+    def remote(self, *args, **kwargs):
+        rid, actor = self._pick_replica()
+        try:
+            if self._stream:
+                gen = actor.handle_request.options(
+                    num_returns="dynamic").remote(
+                        self._method_name, args, kwargs)
+                return DeploymentResponseGenerator(gen, self, rid)
+            ref = actor.handle_request_unary.remote(
+                self._method_name, args, kwargs)
+            return DeploymentResponse(ref, self, rid)
+        except Exception:
+            self._dec(rid)
+            raise
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment_name, self._method_name, self._stream))

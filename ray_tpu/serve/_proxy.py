@@ -1,0 +1,186 @@
+"""HTTP ingress proxy (reference: serve/_private/proxy.py:697 `HTTPProxy`).
+
+Redesign: a stdlib asyncio HTTP/1.1 server inside an async actor — no
+uvicorn/starlette dependency. JSON in/out; streaming handles produce
+chunked-transfer responses (one chunk per generator item)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve._common import CONTROLLER_NAME
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class ProxyActor:
+    def __init__(self, port: int = 0):
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._routes: Dict[str, str] = {}  # prefix -> deployment name
+        self._handles: Dict[str, Any] = {}
+        self._version = -1
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._on_conn, host="127.0.0.1", port=self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        asyncio.ensure_future(self._route_refresh_loop())
+        logger.info("serve HTTP proxy listening on %d", self._port)
+        return self._port
+
+    def port(self) -> int:
+        return self._port
+
+    async def _route_refresh_loop(self) -> None:
+        from ray_tpu.serve._handle import DeploymentHandle
+
+        loop = asyncio.get_running_loop()
+        # get_actor is a blocking driver-style call — it must run on an
+        # executor thread, never on this event loop (it would deadlock the
+        # proxy's accept loop).
+        controller = None
+        while controller is None:
+            try:
+                controller = await loop.run_in_executor(
+                    None, lambda: ray_tpu.get_actor(CONTROLLER_NAME))
+            except Exception:
+                await asyncio.sleep(1.0)
+        while True:
+            try:
+                routing = await controller.get_routing.remote(self._version)
+                if routing is not None:
+                    self._version = routing["version"]
+                    routes = {}
+                    for name, info in routing["deployments"].items():
+                        prefix = info.get("route_prefix")
+                        if prefix:
+                            routes[prefix] = name
+                            if name not in self._handles:
+                                self._handles[name] = DeploymentHandle(name)
+                    self._routes = routes
+            except Exception:
+                logger.exception("route refresh failed")
+            await asyncio.sleep(1.0)
+
+    # ------------------------------------------------------------------
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line == b"\r\n":
+                    return
+                try:
+                    method, path, _ = line.decode().split(" ", 2)
+                except ValueError:
+                    return
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"", b"\n"):
+                        break
+                    k, _, v = h.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", 0) or 0)
+                if n:
+                    body = await reader.readexactly(n)
+                keep = await self._dispatch(method, path, headers, body,
+                                            writer)
+                if not keep:
+                    return
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _match(self, path: str):
+        best = None
+        for prefix, name in self._routes.items():
+            if path == prefix or path.startswith(
+                    prefix.rstrip("/") + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, name)
+        return best
+
+    async def _dispatch(self, method: str, path: str, headers: Dict[str, str],
+                        body: bytes, writer: asyncio.StreamWriter) -> bool:
+        if path == "/-/healthz":
+            await self._respond(writer, 200, b"ok")
+            return True
+        match = self._match(path)
+        if match is None:
+            await self._respond(writer, 404, b"no route")
+            return True
+        prefix, name = match
+        handle = self._handles[name]
+        payload: Any = None
+        if body:
+            try:
+                payload = json.loads(body)
+            except Exception:
+                payload = body.decode(errors="replace")
+        request = {
+            "method": method,
+            "path": path,
+            "suffix": path[len(prefix.rstrip("/")):] or "/",
+            "body": payload,
+            "headers": headers,
+        }
+        stream = headers.get("x-serve-stream", "").lower() in ("1", "true")
+        loop = asyncio.get_running_loop()
+        try:
+            if stream:
+                gen = await loop.run_in_executor(
+                    None, lambda: handle.options(stream=True).remote(request))
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\ncontent-type: application/json\r\n"
+                    b"transfer-encoding: chunked\r\n\r\n")
+                it = iter(gen)
+                _END = object()
+
+                def _next():
+                    try:
+                        return next(it)
+                    except StopIteration:
+                        return _END
+
+                while True:
+                    # One executor hop per item: the generator's blocking
+                    # ray.get must stay off this event loop.
+                    item = await loop.run_in_executor(None, _next)
+                    if item is _END:
+                        break
+                    chunk = (json.dumps(item, default=str) + "\n").encode()
+                    writer.write(hex(len(chunk))[2:].encode() + b"\r\n"
+                                 + chunk + b"\r\n")
+                    await writer.drain()
+                writer.write(b"0\r\n\r\n")
+                await writer.drain()
+                return True
+            resp = await loop.run_in_executor(
+                None, lambda: handle.remote(request).result(timeout=120))
+            data = json.dumps(resp, default=str).encode()
+            await self._respond(writer, 200, data,
+                                ctype=b"application/json")
+            return True
+        except Exception as e:
+            logger.exception("request failed")
+            await self._respond(writer, 500, str(e).encode())
+            return True
+
+    async def _respond(self, writer, status: int, body: bytes,
+                       ctype: bytes = b"text/plain") -> None:
+        writer.write(b"HTTP/1.1 " + str(status).encode() +
+                     b" X\r\ncontent-type: " + ctype +
+                     b"\r\ncontent-length: " + str(len(body)).encode() +
+                     b"\r\nconnection: keep-alive\r\n\r\n" + body)
+        await writer.drain()

@@ -1,0 +1,61 @@
+"""Replica actor: hosts one copy of the user's deployment callable.
+
+Reference: serve/_private/replica.py:918 (`ReplicaActor`) + `UserCallableWrapper`
+(:1165). Redesign: the replica is a plain async actor; request concurrency is
+the actor's max_concurrency; streaming responses use the runtime's native
+streaming generators instead of a bespoke ASGI bridge."""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict, Optional, Tuple
+
+
+class ReplicaActor:
+    def __init__(self, serialized_ctor, init_args: Tuple, init_kwargs: Dict,
+                 user_config: Optional[Dict[str, Any]] = None):
+        import cloudpickle
+
+        ctor = cloudpickle.loads(serialized_ctor)
+        if inspect.isclass(ctor):
+            self._callable = ctor(*init_args, **init_kwargs)
+        else:
+            # Function deployment: the function IS the handler.
+            self._callable = ctor
+        self._user_config = user_config
+        if user_config is not None:
+            reconfigure = getattr(self._callable, "reconfigure", None)
+            if callable(reconfigure):
+                reconfigure(user_config)
+
+    def _resolve_method(self, method_name: str):
+        if callable(self._callable) and method_name == "__call__":
+            return self._callable
+        fn = getattr(self._callable, method_name, None)
+        if fn is None:
+            raise AttributeError(f"deployment has no method {method_name!r}")
+        return fn
+
+    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
+        """Streaming entry (called with num_returns="dynamic")."""
+        result = self._resolve_method(method_name)(*args, **kwargs)
+        if inspect.isgenerator(result):
+            # Streamed via num_returns="dynamic" at the call site.
+            yield from result
+            return
+        yield result
+
+    def handle_request_unary(self, method_name: str, args: Tuple,
+                             kwargs: Dict):
+        return self._resolve_method(method_name)(*args, **kwargs)
+
+    def reconfigure(self, user_config: Dict[str, Any]) -> None:
+        reconfigure = getattr(self._callable, "reconfigure", None)
+        if callable(reconfigure):
+            reconfigure(user_config)
+
+    def check_health(self) -> bool:
+        user_check = getattr(self._callable, "check_health", None)
+        if callable(user_check):
+            user_check()
+        return True
