@@ -79,11 +79,16 @@ def bench_tpu_model():
 
         if jax.default_backend() not in ("tpu",):
             return None
-        from ray_tpu.benchmarks import flash_attention_bench, llama_train_bench
+        from ray_tpu.benchmarks import (
+            flash_attention_bench,
+            llama_train_bench,
+            llm_serving_bench,
+        )
 
         flash = flash_attention_bench()
         llama = llama_train_bench()
-        return {"flash": flash, "llama": llama}
+        serving = llm_serving_bench()
+        return {"flash": flash, "llama": llama, "serving": serving}
     except Exception as e:  # never block the control-plane bench
         print(f"tpu model bench skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -103,6 +108,14 @@ def main():
             f"flash_attention_tflops: {f['flash_tflops']:.1f} "
             f"(speedup vs jnp reference {f['speedup_vs_reference']:.2f}x, "
             f"max_abs_err {f['max_abs_err']:.4f})",
+            file=sys.stderr,
+        )
+        s = tpu["serving"]
+        print(
+            f"llm_serving_decode_tokens_per_s: {s['tokens_per_s']:.0f} "
+            f"({s['params']/1e6:.0f}M params, batch {s['batch']}, "
+            f"TTFT {s['ttft_s']*1e3:.0f} ms; paged KV + continuous "
+            f"batching)",
             file=sys.stderr,
         )
 

@@ -6,5 +6,6 @@ BASELINE.json)."""
 from ray_tpu.benchmarks.model_bench import (  # noqa: F401
     flash_attention_bench,
     llama_train_bench,
+    llm_serving_bench,
     mnist_trainer_bench,
 )

@@ -130,6 +130,54 @@ def llama_train_bench(
     }
 
 
+def llm_serving_bench(*, batch: int = 8, prompt_len: int = 128,
+                      max_tokens: int = 64) -> Dict[str, Any]:
+    """BASELINE config 4 shape: continuous-batching decode throughput +
+    TTFT on the real chip (paged KV + Pallas decode kernel)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm._internal.engine import EngineConfig, LLMEngine, Request
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig(
+        vocab_size=16_384, hidden_size=1024, intermediate_size=2816,
+        num_layers=8, num_heads=8, num_kv_heads=4, head_dim=128,
+        max_seq_len=2048, dtype=jnp.bfloat16, attention_impl="flash",
+        remat=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = LLMEngine(model, params, EngineConfig(
+        max_seqs=batch, page_size=64, max_pages_per_seq=32))
+    rng = np.random.default_rng(0)
+
+    def run_wave():
+        t0 = time.perf_counter()
+        ttft = None
+        for i in range(batch):
+            eng.add_request(Request(
+                f"r{i}", list(rng.integers(1, 16_000, prompt_len)),
+                max_tokens=max_tokens))
+        n_tokens = 0
+        while eng.has_work():
+            outs = eng.step()
+            if outs and ttft is None:
+                ttft = time.perf_counter() - t0
+            n_tokens += len(outs)
+        return n_tokens, time.perf_counter() - t0, ttft
+
+    run_wave()  # warm: compiles prefill bucket + decode step
+    n_tokens, dt, ttft = run_wave()
+    return {
+        "params": sum(x.size for x in jax.tree.leaves(params)),
+        "tokens_per_s": n_tokens / dt,
+        "ttft_s": ttft,
+        "batch": batch,
+    }
+
+
 def mnist_trainer_bench(ray_tpu_mod, *, epochs: int = 3) -> Dict[str, Any]:
     """BASELINE config 1: single-worker MNIST-shaped MLP DataParallelTrainer.
 

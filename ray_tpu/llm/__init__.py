@@ -1,0 +1,48 @@
+"""ray_tpu.llm — LLM serving and batch inference (reference: python/ray/llm).
+
+The reference wraps vLLM's CUDA engine; on TPU this package IS the engine
+(SURVEY §7.3): a continuous-batching scheduler over a paged KV cache with
+jitted prefill/decode steps (see _internal/engine.py, _internal/paged.py),
+deployed on ray_tpu.serve replicas."""
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.llm._internal.engine import EngineConfig, LLMEngine, Request
+from ray_tpu.llm._internal.paged import (
+    PagedCacheConfig,
+    paged_attention,
+    paged_gather,
+    paged_write,
+)
+from ray_tpu.llm._internal.server import LLMServer
+
+
+def build_llm_deployment(llm_config: Dict[str, Any], *,
+                         num_replicas: int = 1,
+                         name: Optional[str] = None,
+                         num_tpus: float = 0.0):
+    """serve Application hosting LLMServer replicas (reference:
+    llm/_internal/serve/builders — build_llm_deployments)."""
+    from ray_tpu import serve
+
+    dep = serve.deployment(
+        LLMServer,
+        name=name or f"LLM:{llm_config.get('model', 'model')}",
+        num_replicas=num_replicas,
+        ray_actor_options={"num_cpus": 1.0, "num_tpus": num_tpus},
+        max_ongoing_requests=int(llm_config.get("max_ongoing_requests", 32)),
+    )
+    return dep.bind(llm_config)
+
+
+__all__ = [
+    "EngineConfig",
+    "LLMEngine",
+    "LLMServer",
+    "PagedCacheConfig",
+    "Request",
+    "build_llm_deployment",
+    "paged_attention",
+    "paged_gather",
+    "paged_write",
+]

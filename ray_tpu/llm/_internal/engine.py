@@ -1,0 +1,277 @@
+"""Continuous-batching LLM engine (reference:
+python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py:180 — the
+reference wraps vLLM's CUDA engine; on TPU we are the engine, SURVEY §7.3).
+
+TPU-first design:
+- one jitted decode step over a FIXED batch of slots (static shapes; idle
+  slots masked) — XLA compiles it once and the MXU stays busy regardless of
+  request churn;
+- prefill jitted per power-of-two length bucket, one sequence at a time,
+  writing straight into the paged KV cache;
+- paged KV cache (llm/_internal/paged.py): host-side page allocator +
+  device-side scatter/gather, donated through the step so pages update
+  in place;
+- greedy/temperature sampling inside the jitted step.
+
+The engine is synchronous and single-model; LLMServer (serve deployment)
+runs it on a background thread and streams tokens per request.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm._internal.paged import (
+    PageAllocator,
+    PagedCacheConfig,
+    init_paged_cache,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    max_seqs: int = 8
+    page_size: int = 16
+    max_pages_per_seq: int = 64
+    num_pages: Optional[int] = None  # default: enough for all slots full
+    prefill_buckets: Tuple[int, ...] = (32, 128, 512, 2048)
+    # Decode iterations per jitted dispatch (multi-step scheduling, like
+    # vLLM's num_scheduler_steps): amortizes host dispatch over K tokens at
+    # the cost of up to K-1 wasted tokens past a stop condition.
+    decode_steps: int = 8
+
+    def resolved_num_pages(self) -> int:
+        return self.num_pages or self.max_seqs * self.max_pages_per_seq
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_ids: List[int]
+    max_tokens: int = 64
+    temperature: float = 0.0
+    stop_token: Optional[int] = None
+    # runtime state
+    slot: int = -1
+    generated: int = 0
+    done: bool = False
+
+
+@dataclasses.dataclass
+class StepOutput:
+    request_id: str
+    token: int
+    finished: bool
+
+
+class LLMEngine:
+    """add_request() + step() — the scheduler half of continuous batching."""
+
+    def __init__(self, model, params, cfg: EngineConfig):
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        mcfg = model.cfg
+        self.cache_cfg = PagedCacheConfig(
+            num_pages=cfg.resolved_num_pages() + 1,  # +1: OOB drop page
+            page_size=cfg.page_size, max_seqs=cfg.max_seqs,
+            max_pages_per_seq=cfg.max_pages_per_seq)
+        self.caches = init_paged_cache(
+            self.cache_cfg, mcfg.num_layers, mcfg.num_kv_heads,
+            mcfg.head_dim, mcfg.dtype)
+        self.allocator = PageAllocator(self.cache_cfg)
+        # reserve nothing: allocator hands out real pages; the scatter's
+        # drop-page is index num_pages (out of bounds by construction).
+        self.waiting: deque = deque()
+        self.running: Dict[int, Request] = {}
+        # host mirrors of device state
+        self.page_table = np.zeros(
+            (cfg.max_seqs, cfg.max_pages_per_seq), np.int32)
+        self.seq_lens = np.zeros((cfg.max_seqs,), np.int32)
+        self.last_tokens = np.zeros((cfg.max_seqs,), np.int32)
+        self.temps = np.zeros((cfg.max_seqs,), np.float32)
+        self._rng = jax.random.PRNGKey(0)
+        self._decode_fn = self._build_decode()
+        self._prefill_fns: Dict[int, Callable] = {}
+        self._free_slots = list(range(cfg.max_seqs))
+
+    # ------------------------------------------------------------------
+    # Jitted steps
+    # ------------------------------------------------------------------
+    def _build_decode(self):
+        model = self.model
+        K = max(1, self.cfg.decode_steps)
+
+        def one(params, caches, last_tokens, page_table, seq_lens, active,
+                temps, rng):
+            # positions of the NEW token = current length (before write).
+            positions = seq_lens[:, None]
+            logits, new_caches = model.apply(
+                {"params": params}, last_tokens[:, None],
+                positions=positions, paged_kv=caches,
+                page_table=page_table, write_mask=active[:, None],
+                seq_lens=seq_lens + 1)
+            logits = logits[:, 0].astype(jnp.float32)  # [B, V]
+            greedy = jnp.argmax(logits, axis=-1)
+            keys = jax.random.split(rng, logits.shape[0] + 1)
+            sampled = jax.vmap(
+                lambda k, l, t: jax.random.categorical(k, l / jnp.maximum(
+                    t, 1e-3)))(keys[1:], logits, temps)
+            toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            return toks, new_caches, keys[0]
+
+        def decode(params, caches, last_tokens, page_table, seq_lens,
+                   active, temps, rng):
+            out = jnp.zeros((K, last_tokens.shape[0]), jnp.int32)
+
+            def body(j, carry):
+                caches, toks, lens, rng, out = carry
+                toks, caches, rng = one(params, caches, toks, page_table,
+                                        lens, active, temps, rng)
+                return caches, toks, lens + 1, rng, out.at[j].set(toks)
+
+            caches, _, _, rng, out = jax.lax.fori_loop(
+                0, K, body, (caches, last_tokens, seq_lens, rng, out))
+            return out, caches, rng
+
+        return jax.jit(decode, donate_argnums=(1,))
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model = self.model
+
+        def prefill(params, caches, ids, page_table_row, true_len,
+                    temps, rng):
+            # ids [1, bucket]; single sequence, causal within the bucket.
+            positions = jnp.arange(bucket)[None, :]
+            mask = positions < true_len
+            logits, new_caches = model.apply(
+                {"params": params}, ids, positions=positions,
+                paged_kv=caches, page_table=page_table_row[None, :],
+                write_mask=mask, seq_lens=jnp.full((1,), true_len))
+            last = logits[0, true_len - 1].astype(jnp.float32)
+            greedy = jnp.argmax(last)
+            k1, k0 = jax.random.split(rng)
+            sampled = jax.random.categorical(
+                k1, last / jnp.maximum(temps, 1e-3))
+            tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            return tok, new_caches, k0
+
+        fn = jax.jit(prefill, donate_argnums=(1,))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # Scheduler
+    # ------------------------------------------------------------------
+    def add_request(self, req: Request) -> None:
+        # Multi-step decode may overshoot by up to decode_steps-1 writes.
+        need = (len(req.prompt_ids) + req.max_tokens
+                + max(1, self.cfg.decode_steps) - 1)
+        if need > self.cache_cfg.max_context:
+            raise ValueError(
+                f"request needs up to {need} cache slots; max context is "
+                f"{self.cache_cfg.max_context}")
+        self.waiting.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def step(self) -> List[StepOutput]:
+        """Admit + prefill waiting requests, then one decode step."""
+        out: List[StepOutput] = []
+        self._admit(out)
+        if not self.running:
+            return out
+        K = max(1, self.cfg.decode_steps)
+        self._ensure_decode_pages(K)
+        active = np.zeros((self.cfg.max_seqs,), bool)
+        for slot in self.running:
+            active[slot] = True
+        toks, self.caches, self._rng = self._decode_fn(
+            self.params, self.caches, jnp.asarray(self.last_tokens),
+            jnp.asarray(self.page_table), jnp.asarray(self.seq_lens),
+            jnp.asarray(active), jnp.asarray(self.temps), self._rng)
+        toks = np.asarray(toks)  # [K, B]
+        for slot, req in list(self.running.items()):
+            for j in range(K):
+                tok = int(toks[j, slot])
+                self.seq_lens[slot] += 1
+                self.last_tokens[slot] = tok
+                req.generated += 1
+                finished = (req.generated >= req.max_tokens
+                            or (req.stop_token is not None
+                                and tok == req.stop_token))
+                out.append(StepOutput(req.request_id, tok, finished))
+                if finished:
+                    # Tokens past the stop within this window are wasted
+                    # compute (multi-step tradeoff); drop them.
+                    self._release(slot)
+                    break
+        return out
+
+    def _admit(self, out: List[StepOutput]) -> None:
+        while self.waiting and self._free_slots:
+            req: Request = self.waiting[0]
+            need = len(req.prompt_ids) + 1  # prompt + first decode page room
+            if not self.allocator.can_allocate(need):
+                break  # wait for running requests to free pages
+            self.waiting.popleft()
+            slot = self._free_slots.pop()
+            req.slot = slot
+            self.running[slot] = req
+            pages = self.allocator.ensure(slot, need)
+            row = np.zeros((self.cfg.max_pages_per_seq,), np.int32)
+            row[:len(pages)] = pages
+            self.page_table[slot] = row
+            T = len(req.prompt_ids)
+            bucket = next((b for b in self.cfg.prefill_buckets if b >= T),
+                          self.cache_cfg.max_context)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :T] = req.prompt_ids
+            self.temps[slot] = req.temperature
+            tok, self.caches, self._rng = self._prefill_fn(bucket)(
+                self.params, self.caches, jnp.asarray(ids),
+                jnp.asarray(row), jnp.asarray(T, jnp.int32),
+                jnp.asarray(req.temperature, jnp.float32), self._rng)
+            tok = int(tok)
+            self.seq_lens[slot] = T
+            self.last_tokens[slot] = tok
+            req.generated = 1
+            finished = (req.generated >= req.max_tokens
+                        or (req.stop_token is not None
+                            and tok == req.stop_token))
+            out.append(StepOutput(req.request_id, tok, finished))
+            if finished:
+                self._release(slot)
+
+    def _ensure_decode_pages(self, k: int = 1) -> None:
+        """Each running slot is about to append up to k tokens starting at
+        seq_lens[slot]; grow its page list to cover them."""
+        for slot in list(self.running):
+            pages = self.allocator.ensure(slot, int(self.seq_lens[slot]) + k)
+            row = self.page_table[slot]
+            row[:len(pages)] = pages
+
+    def _release(self, slot: int) -> None:
+        self.running.pop(slot, None)
+        self.allocator.release(slot)
+        self._free_slots.append(slot)
+        self.seq_lens[slot] = 0

@@ -1,0 +1,140 @@
+"""LLMServer: the serve deployment hosting one engine replica.
+
+Reference: llm/_internal/serve/deployments/llm/llm_server.py + vllm_engine.py
+(there the engine is vLLM's; here it's ray_tpu.llm._internal.engine). The
+engine runs on a dedicated thread; request handlers enqueue work and stream
+tokens back through per-request queues (serve streams them as generator
+items)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+from ray_tpu.llm._internal.engine import EngineConfig, LLMEngine, Request
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class LLMServer:
+    def __init__(self, llm_config: Dict[str, Any]):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+        model_cfg = llm_config.get("model_config") or {}
+        preset = llm_config.get("model", "tiny")
+        if preset == "tiny":
+            cfg = LlamaConfig.tiny(**model_cfg)
+        elif preset == "llama3-8b":
+            cfg = LlamaConfig.llama3_8b()
+        else:
+            cfg = LlamaConfig(**model_cfg)
+        self.model = LlamaModel(cfg)
+        params_path = llm_config.get("params_path")
+        if params_path:
+            import pickle
+
+            with open(params_path, "rb") as f:
+                self.params = pickle.load(f)
+        else:
+            seed = int(llm_config.get("seed", 0))
+            sample = jnp.zeros((1, 8), jnp.int32)
+            self.params = self.model.init(
+                jax.random.PRNGKey(seed), sample)["params"]
+        eng_cfg = EngineConfig(**(llm_config.get("engine_config") or {}))
+        self.engine = LLMEngine(self.model, self.params, eng_cfg)
+        self._queues: Dict[str, "queue.Queue"] = {}
+        self._lock = threading.Lock()
+        self._pending: "queue.Queue" = queue.Queue()
+        self._running = True
+        threading.Thread(target=self._engine_loop, daemon=True,
+                         name="llm-engine").start()
+
+    # ------------------------------------------------------------------
+    def _engine_loop(self) -> None:
+        while self._running:
+            moved = False
+            while True:
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                self.engine.add_request(req)
+                moved = True
+            if not self.engine.has_work():
+                time.sleep(0.005 if moved else 0.01)
+                continue
+            try:
+                outputs = self.engine.step()
+            except Exception as e:
+                logger.exception("engine step failed")
+                with self._lock:
+                    for q in self._queues.values():
+                        q.put(("error", str(e)))
+                    self._queues.clear()
+                continue
+            for so in outputs:
+                with self._lock:
+                    q = self._queues.get(so.request_id)
+                if q is not None:
+                    q.put(("token", so.token, so.finished))
+
+    # ------------------------------------------------------------------
+    def generate(self, prompt_ids: List[int], max_tokens: int = 64,
+                 temperature: float = 0.0,
+                 stop_token: Optional[int] = None) -> Iterator[Dict[str, Any]]:
+        """Streaming generation — one dict per token."""
+        rid = uuid.uuid4().hex[:12]
+        q: "queue.Queue" = queue.Queue()
+        with self._lock:
+            self._queues[rid] = q
+        t0 = time.perf_counter()
+        self._pending.put(Request(rid, list(prompt_ids),
+                                  max_tokens=max_tokens,
+                                  temperature=temperature,
+                                  stop_token=stop_token))
+        first = True
+        try:
+            while True:
+                item = q.get(timeout=600)
+                if item[0] == "error":
+                    raise RuntimeError(f"engine failed: {item[1]}")
+                _, tok, finished = item
+                out = {"token": int(tok)}
+                if first:
+                    out["ttft_s"] = time.perf_counter() - t0
+                    first = False
+                yield out
+                if finished:
+                    return
+        finally:
+            with self._lock:
+                self._queues.pop(rid, None)
+
+    def generate_all(self, prompt_ids: List[int], max_tokens: int = 64,
+                     temperature: float = 0.0,
+                     stop_token: Optional[int] = None) -> Dict[str, Any]:
+        """Unary variant: returns all tokens at once."""
+        toks = []
+        ttft = None
+        for item in self.generate(prompt_ids, max_tokens, temperature,
+                                  stop_token):
+            toks.append(item["token"])
+            ttft = ttft if ttft is not None else item.get("ttft_s")
+        return {"tokens": toks, "ttft_s": ttft}
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "running": self.engine.num_running(),
+            "waiting": len(self.engine.waiting),
+            "free_pages": self.engine.allocator.num_free,
+        }
+
+    def check_health(self) -> bool:
+        return True

@@ -114,7 +114,8 @@ class Attention(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None, cache_index=None):
+    def __call__(self, x, positions, kv_cache=None, cache_index=None,
+                 paged=None):
         cfg = self.cfg
         b, s, _ = x.shape
         h, hk, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -126,6 +127,24 @@ class Attention(nn.Module):
         v = dense((hk, d), "v_proj")(x)
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
+
+        if paged is not None:
+            # Paged KV decode/prefill (serving engine; llm/_internal/paged).
+            from ray_tpu.llm._internal.paged import paged_attention, paged_write
+
+            k_pages, v_pages = paged["kv_pages"]
+            pos2d = positions if positions.ndim == 2 else jnp.broadcast_to(
+                positions[None, :], (b, s))
+            k_pages = paged_write(k_pages, k, paged["page_table"], pos2d,
+                                  paged["write_mask"])
+            v_pages = paged_write(v_pages, v, paged["page_table"], pos2d,
+                                  paged["write_mask"])
+            out = paged_attention(q, k_pages, v_pages, paged["page_table"],
+                                  pos2d, paged["seq_lens"])
+            out = nn.DenseGeneral(
+                cfg.hidden_size, axis=(-2, -1), use_bias=False,
+                dtype=cfg.dtype, param_dtype=jnp.float32, name="o_proj")(out)
+            return out, (k_pages, v_pages)
 
         if kv_cache is not None:
             # Decode: append to cache, attend over the prefix.
@@ -191,11 +210,12 @@ class DecoderLayer(nn.Module):
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, x, positions, kv_cache=None, cache_index=None):
+    def __call__(self, x, positions, kv_cache=None, cache_index=None,
+                 paged=None):
         cfg = self.cfg
         attn_out, new_cache = Attention(cfg, self.mesh, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x),
-            positions, kv_cache, cache_index)
+            positions, kv_cache, cache_index, paged)
         x = x + attn_out
         x = x + Mlp(cfg, name="mlp")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype,
@@ -209,7 +229,8 @@ class LlamaModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, kv_caches=None,
-                 cache_index=None):
+                 cache_index=None, paged_kv=None, page_table=None,
+                 write_mask=None, seq_lens=None):
         cfg = self.cfg
         if positions is None:
             start = cache_index if (kv_caches is not None
@@ -218,18 +239,22 @@ class LlamaModel(nn.Module):
         x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="embed_tokens")(input_ids)
         layer_cls = DecoderLayer
-        if cfg.remat and kv_caches is None:
+        if cfg.remat and kv_caches is None and paged_kv is None:
             layer_cls = nn.remat(DecoderLayer, static_argnums=())
         new_caches = []
         for i in range(cfg.num_layers):
             cache = kv_caches[i] if kv_caches is not None else None
+            paged = None
+            if paged_kv is not None:
+                paged = {"kv_pages": paged_kv[i], "page_table": page_table,
+                         "write_mask": write_mask, "seq_lens": seq_lens}
             x, new_cache = layer_cls(cfg, self.mesh, name=f"layers_{i}")(
-                x, positions, cache, cache_index)
+                x, positions, cache, cache_index, paged)
             new_caches.append(new_cache)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           param_dtype=jnp.float32, name="lm_head")(x)
-        if kv_caches is not None:
+        if kv_caches is not None or paged_kv is not None:
             return logits, new_caches
         return logits
 
