@@ -1,0 +1,27 @@
+"""ray_tpu.rllib — reinforcement learning (reference: rllib/ new API stack).
+
+PPO with a flax RLModule, EnvRunnerGroup of sampling actors, and a
+LearnerGroup running jitted PPO updates (see ppo.py, learner.py,
+env_runner.py, rl_module.py)."""
+
+from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.learner import (
+    LearnerGroup,
+    PPOLearner,
+    PPOLearnerConfig,
+    compute_gae,
+)
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.rl_module import RLModule
+
+__all__ = [
+    "EnvRunnerGroup",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "PPOLearnerConfig",
+    "RLModule",
+    "SingleAgentEnvRunner",
+    "compute_gae",
+]

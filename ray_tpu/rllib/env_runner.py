@@ -1,0 +1,106 @@
+"""EnvRunner: actor that steps vectorized gym envs with the current policy
+(reference: rllib/env/single_agent_env_runner.py:68 + env_runner_group.py:71).
+
+Runners hold CPU envs + a CPU copy of the params; the learner ships new
+params after each update (sync_weights)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.rl_module import RLModule
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, env_fn, module: RLModule, num_envs: int = 4,
+                 seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        self.envs = [env_fn() for _ in range(num_envs)]
+        self.module = module
+        self.params = None
+        self._key = jax.random.PRNGKey(seed)
+        self.obs = np.stack([e.reset(seed=seed + i)[0]
+                             for i, e in enumerate(self.envs)])
+        self._ep_returns = np.zeros(num_envs)
+        self._done_returns: List[float] = []
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        """Rollout num_steps per env. Returns flat [T*N, ...] arrays plus
+        bootstrap values/flags for GAE."""
+        import jax
+
+        n = len(self.envs)
+        obs_buf = np.empty((num_steps, n) + self.obs.shape[1:], np.float32)
+        act_buf = np.empty((num_steps, n), np.int64)
+        logp_buf = np.empty((num_steps, n), np.float32)
+        val_buf = np.empty((num_steps, n), np.float32)
+        rew_buf = np.empty((num_steps, n), np.float32)
+        done_buf = np.empty((num_steps, n), np.float32)
+        for t in range(num_steps):
+            self._key, sub = jax.random.split(self._key)
+            actions, logps, values = self.module.forward_inference(
+                self.params, self.obs.astype(np.float32), sub)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = logps
+            val_buf[t] = values
+            for i, env in enumerate(self.envs):
+                nobs, rew, term, trunc, _ = env.step(int(actions[i]))
+                rew_buf[t, i] = rew
+                done = term or trunc
+                done_buf[t, i] = float(done)
+                self._ep_returns[i] += rew
+                if done:
+                    self._done_returns.append(self._ep_returns[i])
+                    self._ep_returns[i] = 0.0
+                    nobs, _ = env.reset()
+                self.obs[i] = nobs
+        self._key, sub = jax.random.split(self._key)
+        _, _, last_vals = self.module.forward_inference(
+            self.params, self.obs.astype(np.float32), sub)
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_values": last_vals,
+        }
+
+    def episode_returns(self) -> List[float]:
+        out, self._done_returns = self._done_returns, []
+        return out
+
+
+class EnvRunnerGroup:
+    """Fan-out over runner actors (reference: env_runner_group.py:71)."""
+
+    def __init__(self, env_fn, module: RLModule, *, num_runners: int = 2,
+                 num_envs_per_runner: int = 4, seed: int = 0):
+        Runner = ray_tpu.remote(SingleAgentEnvRunner)
+        self.runners = [
+            Runner.options(num_cpus=1.0).remote(
+                env_fn, module, num_envs_per_runner, seed + 1000 * i)
+            for i in range(num_runners)
+        ]
+
+    def sync_weights(self, params) -> None:
+        ray_tpu.get([r.set_weights.remote(params) for r in self.runners],
+                    timeout=120)
+
+    def sample(self, num_steps_per_runner: int) -> List[Dict[str, Any]]:
+        return ray_tpu.get(
+            [r.sample.remote(num_steps_per_runner) for r in self.runners],
+            timeout=600)
+
+    def episode_returns(self) -> List[float]:
+        outs = ray_tpu.get([r.episode_returns.remote()
+                            for r in self.runners], timeout=120)
+        return [x for sub in outs for x in sub]

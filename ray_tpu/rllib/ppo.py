@@ -1,0 +1,139 @@
+"""PPO algorithm (reference: rllib/algorithms/ppo/ppo.py:60,
+training_step:388; config builder rllib/algorithms/algorithm_config.py).
+
+training_step = synchronous sample fan-out over the EnvRunnerGroup →
+GAE → LearnerGroup.update → sync_weights, mirroring the reference's new
+API stack with flax/jax in place of torch."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner import (
+    LearnerGroup,
+    PPOLearner,
+    PPOLearnerConfig,
+    compute_gae,
+)
+from ray_tpu.rllib.rl_module import RLModule
+
+
+class PPOConfig:
+    """Builder-style config (reference: AlgorithmConfig fluent API)."""
+
+    def __init__(self):
+        self._env_fn: Optional[Callable] = None
+        self._obs_dim: Optional[int] = None
+        self._num_actions: Optional[int] = None
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_length = 64
+        self.num_learners = 0
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.learner = PPOLearnerConfig()
+
+    def environment(self, env: Any = None, *,
+                    env_fn: Optional[Callable] = None) -> "PPOConfig":
+        if env_fn is not None:
+            self._env_fn = env_fn
+        elif isinstance(env, str):
+            name = env
+
+            def make():
+                import gymnasium
+
+                return gymnasium.make(name)
+
+            self._env_fn = make
+        else:
+            self._env_fn = env
+        return self
+
+    def env_runners(self, *, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 4,
+                    rollout_fragment_length: int = 64) -> "PPOConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_length = rollout_fragment_length
+        return self
+
+    def learners(self, *, num_learners: int = 0) -> "PPOConfig":
+        self.num_learners = num_learners
+        return self
+
+    def training(self, **overrides) -> "PPOConfig":
+        for k, v in overrides.items():
+            if hasattr(self.learner, k):
+                setattr(self.learner, k, v)
+            elif k == "model_hidden":
+                self.hidden = tuple(v)
+            else:
+                raise ValueError(f"unknown training option {k!r}")
+        return self
+
+    def debugging(self, *, seed: int = 0) -> "PPOConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "PPO":
+        return PPO(self)
+
+
+class PPO:
+    def __init__(self, config: PPOConfig):
+        assert config._env_fn is not None, "call .environment(...) first"
+        self.config = config
+        probe = config._env_fn()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        self.module = RLModule(obs_dim, num_actions, config.hidden)
+        self.learner_group = LearnerGroup(
+            self.module, config.learner, config.num_learners, config.seed)
+        self.env_runners = EnvRunnerGroup(
+            config._env_fn, self.module,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed)
+        self.env_runners.sync_weights(self.learner_group.get_weights())
+        self.iteration = 0
+        self._return_window: List[float] = []
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        rollouts = self.env_runners.sample(cfg.rollout_length)
+        t_sample = time.perf_counter() - t0
+        batches = [compute_gae(r, cfg.learner.gamma, cfg.learner.gae_lambda)
+                   for r in rollouts]
+        result = self.learner_group.update(batches)
+        self.env_runners.sync_weights(self.learner_group.get_weights())
+        self._return_window.extend(self.env_runners.episode_returns())
+        self._return_window = self._return_window[-100:]
+        t_total = time.perf_counter() - t0
+        steps = sum(b["obs"].shape[0] for b in batches)
+        return {
+            "loss": result["loss"],
+            "env_steps_this_iter": steps,
+            "env_steps_per_s": steps / t_total,
+            "sample_time_s": t_sample,
+            "episode_return_mean": (float(np.mean(self._return_window))
+                                    if self._return_window else float("nan")),
+        }
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        out = self.training_step()
+        out["training_iteration"] = self.iteration
+        return out
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self) -> None:
+        pass

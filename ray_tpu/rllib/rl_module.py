@@ -1,0 +1,68 @@
+"""RLModule: the neural policy/value container (reference:
+rllib/core/rl_module/rl_module.py:258 — torch; here flax, jitted).
+
+TPU-first: forward passes are jitted pure functions over a params pytree;
+the module object is stateless and picklable, so env runners and learners
+ship it once and exchange only params."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ActorCriticNet(nn.Module):
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.tanh(nn.Dense(h)(x))
+        logits = nn.Dense(self.num_actions)(x)
+        value = nn.Dense(1)(x)[..., 0]
+        return logits, value
+
+
+class RLModule:
+    """Discrete-action actor-critic module."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.net = ActorCriticNet(num_actions, tuple(hidden))
+        self._fwd = jax.jit(
+            lambda p, obs: self.net.apply({"params": p}, obs))
+
+        def sample_action(params, obs, key):
+            logits, value = self.net.apply({"params": params}, obs)
+            action = jax.random.categorical(key, logits)
+            logp = jax.nn.log_softmax(logits)[
+                jnp.arange(logits.shape[0]), action]
+            return action, logp, value
+
+        self._sample = jax.jit(sample_action)
+
+    def init_params(self, rng: jax.Array):
+        return self.net.init(rng, jnp.zeros((1, self.obs_dim)))["params"]
+
+    def forward_inference(self, params, obs: np.ndarray,
+                          key) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        a, logp, v = self._sample(params, jnp.asarray(obs), key)
+        return np.asarray(a), np.asarray(logp), np.asarray(v)
+
+    def forward_train(self, params, obs):
+        return self._fwd(params, obs)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        return {"obs_dim": self.obs_dim, "num_actions": self.num_actions,
+                "hidden": tuple(self.net.hidden)}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__init__(**state)
