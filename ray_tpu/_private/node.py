@@ -113,11 +113,13 @@ class Node:
         if head:
             gcs_port = free_port()
             self.gcs_address = ("127.0.0.1", gcs_port)
-            self._start_process(
-                [sys.executable, "-m", "ray_tpu.core.gcs",
-                 "--host", "127.0.0.1", "--port", str(gcs_port)],
-                "gcs",
-            )
+            self._gcs_cmd = [
+                sys.executable, "-m", "ray_tpu.core.gcs",
+                "--host", "127.0.0.1", "--port", str(gcs_port),
+                "--persist-path",
+                os.path.join(self.session_dir, "gcs_snapshot.pkl"),
+            ]
+            self._gcs_proc = self._start_process(self._gcs_cmd, "gcs")
             _wait_port(*self.gcs_address)
         else:
             assert gcs_address is not None
@@ -142,6 +144,20 @@ class Node:
         self.store_path = self._wait_store_path()
         atexit.register(self.shutdown)
         _register_signal_cleanup(self)
+
+    def restart_gcs(self, graceful: bool = False) -> None:
+        """Kill the GCS process and start a fresh one on the same port with
+        the same snapshot path (GCS fault-tolerance test hook; reference:
+        Redis-backed GCS restart)."""
+        assert self.head, "only the head node hosts the GCS"
+        if graceful:
+            self._gcs_proc.terminate()
+        else:
+            self._gcs_proc.kill()
+        self._gcs_proc.wait()
+        self.processes.remove(self._gcs_proc)
+        self._gcs_proc = self._start_process(self._gcs_cmd, "gcs")
+        _wait_port(*self.gcs_address)
 
     def _start_process(self, cmd: List[str], name: str) -> subprocess.Popen:
         log = open(os.path.join(self.session_dir, "logs", f"{name}.log"), "wb")

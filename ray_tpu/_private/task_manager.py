@@ -12,7 +12,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.serialization import SerializedObject
-from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.task_spec import TaskSpec, TaskType
 
 
 class PendingTask:
@@ -27,6 +27,7 @@ class PendingTask:
 class TaskManager:
     def __init__(self, put_result: Callable[[ObjectID, Any], None]):
         self._pending: Dict[TaskID, PendingTask] = {}
+        self._lineage: Dict[ObjectID, TaskSpec] = {}
         self._lock = threading.Lock()
         self._put_result = put_result
 
@@ -53,7 +54,28 @@ class TaskManager:
         if pt is None:
             return
         for i, result in enumerate(results):
-            self._put_result(ObjectID.for_task_return(task_id, i), result)
+            oid = ObjectID.for_task_return(task_id, i)
+            # Lineage retention (reference: TaskManager lineage pinning +
+            # object_recovery_manager.h:43): keep the spec of normal tasks
+            # whose outputs may need re-execution after object loss. Actor
+            # results are excluded (re-running a method against mutated
+            # actor state is not replay-safe).
+            if pt.spec.task_type == TaskType.NORMAL_TASK:
+                with self._lock:
+                    self._lineage[oid] = pt.spec
+            self._put_result(oid, result)
+
+    def lineage_spec(self, object_id: ObjectID) -> Optional[TaskSpec]:
+        with self._lock:
+            return self._lineage.get(object_id)
+
+    def drop_lineage(self, object_id: ObjectID) -> None:
+        with self._lock:
+            spec = self._lineage.pop(object_id, None)
+        # The spec's destruction can cascade (its ObjectRef args drop their
+        # local refs -> _on_owned_ref_zero -> drop_lineage again). That MUST
+        # happen outside the lock — destroying it inside self-deadlocks.
+        del spec
 
     def fail_or_retry(self, task_id: TaskID) -> Optional[TaskSpec]:
         """On a retryable failure: return the spec to resubmit, or None if

@@ -441,9 +441,12 @@ class ActorSubmitter:
             return self.client
         cfg = get_config()
         deadline = time.monotonic() + cfg.worker_start_timeout_s
+        # Event-driven: the worker's GCS pubsub subscription pushes actor
+        # state transitions; we wait on those instead of 50ms polling
+        # (reference: actor submitters subscribe to GCS actor pubsub).
+        w = self.worker
+        info = await w.actor_state(self.actor_id, refresh=True)
         while True:
-            info = await self.worker.gcs_client.call(
-                "get_actor", actor_id=self.actor_id.binary())
             if info is None:
                 raise ActorDiedError(f"actor {self.actor_id} was never created")
             if info["state"] == "ALIVE" and info["address"]:
@@ -456,7 +459,9 @@ class ActorSubmitter:
             if time.monotonic() > deadline:
                 raise ActorUnavailableError(
                     f"actor {self.actor_id} stuck in {info['state']}")
-            await asyncio.sleep(0.05)
+            info = await w.actor_state(
+                self.actor_id,
+                wait_change=min(5.0, deadline - time.monotonic()))
 
     def reset(self) -> None:
         client, self.client, self.address = self.client, None, None
@@ -531,6 +536,13 @@ class Worker:
         self._cancelled_tasks: set = set()
         # Streaming generators (owner side): task_id -> GeneratorState.
         self._generators: Dict[TaskID, Any] = {}
+        # In-flight lineage recoveries: object_id -> future.
+        self._recoveries: Dict[ObjectID, "asyncio.Future"] = {}
+        # Actor-state cache fed by GCS pubsub (replaces per-submitter
+        # polling). Keyed by actor_id hex; _actor_pulse fires on any update.
+        self._actor_states: Dict[str, Dict[str, Any]] = {}
+        self._actor_pulse = asyncio.Event()
+        self._actor_sub_started = False
         # Executor side: cached clients for streaming items back to owners.
         self._gen_clients: Dict[Tuple[str, int], RpcClient] = {}
         self.connected = False
@@ -635,6 +647,7 @@ class Worker:
     # ------------------------------------------------------------------
     def _on_owned_ref_zero(self, object_id: ObjectID) -> None:
         self.memory_store.delete(object_id)
+        self.task_manager.drop_lineage(object_id)
         try:
             self.shm.delete(object_id)
         except Exception:
@@ -721,9 +734,61 @@ class Worker:
             except asyncio.TimeoutError:
                 raise GetTimeoutError(f"timed out resolving {ref}")
         if entry is not None:
-            return await self._materialize(ref.id, entry, deadline)
+            try:
+                return await self._materialize(ref.id, entry, deadline)
+            except ObjectLostError:
+                # Owned object lost (node death / eviction): re-execute its
+                # producing task from retained lineage (reference:
+                # object_recovery_manager.h:43).
+                obj = await self._recover_object(ref.id, deadline)
+                if obj is not None:
+                    return obj
+                raise
         # 3. Borrowed: ask the owner.
         return await self._resolve_from_owner(ref, deadline)
+
+    async def _recover_object(self, object_id: ObjectID,
+                              deadline: Optional[float]
+                              ) -> Optional[ser.SerializedObject]:
+        """Lineage re-execution for a lost owned object. Returns the
+        materialized object, or None when no lineage exists. Concurrent
+        recoveries of the same object share one re-execution."""
+        fut = self._recoveries.get(object_id)
+        if fut is None:
+            spec = self.task_manager.lineage_spec(object_id)
+            if spec is None:
+                return None
+            logger.warning("object %s lost; re-executing %s from lineage",
+                           object_id, spec.function_name)
+            fut = asyncio.ensure_future(self._rerun_lineage(spec, object_id))
+            self._recoveries[object_id] = fut
+
+            def _cleanup(f, oid=object_id):
+                if self._recoveries.get(oid) is f:
+                    del self._recoveries[oid]
+
+            fut.add_done_callback(_cleanup)
+        await asyncio.shield(fut)
+        entry = self.memory_store.get_if_exists(object_id)
+        if entry is None:
+            return None
+        return await self._materialize(object_id, entry, deadline)
+
+    async def _rerun_lineage(self, spec: TaskSpec, object_id: ObjectID) -> None:
+        # Clear the stale marker so completion waits on the fresh result.
+        self.memory_store.delete(object_id)
+        self.task_manager.add_pending(spec)
+        key = spec.scheduling_key()
+        pool = self._lease_pools.get(key)
+        if pool is None:
+            pool = LeasePool(self, key, spec)
+            self._lease_pools[key] = pool
+        deps = self.unresolved_owned_deps(spec)
+        if deps:
+            await self.wait_owned_deps(deps)
+        pool.queue.put_nowait(spec)
+        pool.maybe_scale_up()
+        await self.memory_store.get(object_id, None)
 
     async def _materialize(self, object_id: ObjectID, entry: Any,
                            deadline: Optional[float]) -> ser.SerializedObject:
@@ -751,6 +816,10 @@ class Worker:
             reply = await client.call(
                 "fetch_object", object_id=object_id.binary(),
                 timeout=None if deadline is None else deadline - time.monotonic())
+        except (ConnectionLost, RemoteError, OSError) as e:
+            # Node died faster than the GCS noticed — same as "gone".
+            raise ObjectLostError(
+                f"node holding {object_id} unreachable: {e!r}") from e
         finally:
             await client.close()
         if reply is None:
@@ -787,8 +856,21 @@ class Worker:
                 if kind == "shm":
                     if self.shm.contains(ref.id):
                         return self.shm.get_serialized(ref.id)
-                    return await self._fetch_remote(
-                        ref.id, reply["node_id"], deadline)
+                    try:
+                        return await self._fetch_remote(
+                            ref.id, reply["node_id"], deadline)
+                    except ObjectLostError:
+                        # Ask the owner to recover it (lineage lives there).
+                        reply = await client.call(
+                            "get_object", object_id=ref.id.binary(),
+                            borrower=self.address, recover=True, timeout=t)
+                        if reply["kind"] == "inline":
+                            return ser.SerializedObject(
+                                reply["metadata"], reply["buffers"], [])
+                        if reply["kind"] == "shm":
+                            return await self._fetch_remote(
+                                ref.id, reply["node_id"], deadline)
+                        raise
                 if kind == "pending":
                     await asyncio.sleep(0.02)
                     continue
@@ -796,11 +878,47 @@ class Worker:
         finally:
             await client.close()
 
+    async def _ready_ref(self, ref: ObjectRef,
+                         timeout: Optional[float]) -> None:
+        """Readiness by metadata only (reference: wait_manager.h:30) — never
+        pulls a remote payload; ray.wait on a large remote object must not
+        move it."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if self.shm.contains(ref.id):
+            return
+        entry = self.memory_store.get_if_exists(ref.id)
+        if entry is None and (ref.owner_address is None
+                              or tuple(ref.owner_address) == self.address):
+            await self.memory_store.get(
+                ref.id, None if deadline is None
+                else max(0.0, deadline - time.monotonic()))
+            return
+        if entry is not None:
+            return
+        owner = tuple(ref.owner_address)
+        client = RpcClient(*owner, name="owner-wait")
+        try:
+            while True:
+                t = None if deadline is None else max(
+                    0.1, deadline - time.monotonic())
+                reply = await client.call(
+                    "get_object", object_id=ref.id.binary(),
+                    borrower=self.address, timeout=t)
+                if reply["kind"] in ("inline", "shm"):
+                    return
+                if reply["kind"] == "pending":
+                    await asyncio.sleep(0.02)
+                    continue
+                raise ObjectLostError(
+                    f"object {ref} lost: {reply.get('error')}")
+        finally:
+            await client.close()
+
     def wait(self, refs: List[ObjectRef], num_returns: int,
              timeout: Optional[float]) -> Tuple[List[ObjectRef], List[ObjectRef]]:
         async def _wait():
             tasks = {
-                asyncio.ensure_future(self._resolve_ref(r, timeout)): r
+                asyncio.ensure_future(self._ready_ref(r, timeout)): r
                 for r in refs
             }
             ready: List[ObjectRef] = []
@@ -973,6 +1091,60 @@ class Worker:
             return None
         self._spread_rr += 1
         return self._spread_nodes[self._spread_rr % len(self._spread_nodes)]
+
+    async def actor_state(self, actor_id: ActorID, *,
+                          refresh: bool = False,
+                          wait_change: Optional[float] = None
+                          ) -> Optional[Dict[str, Any]]:
+        """Cached actor info from the GCS pubsub subscription. refresh=True
+        bootstraps with one get_actor RPC (the subscription may have started
+        after the actor's transitions); wait_change waits for the next push
+        before re-reading the cache."""
+        if not self._actor_sub_started:
+            self._actor_sub_started = True
+            asyncio.ensure_future(self._actor_pubsub_loop())
+        if wait_change is not None:
+            pulse = self._actor_pulse
+            try:
+                await asyncio.wait_for(pulse.wait(), wait_change)
+                cached = self._actor_states.get(actor_id.hex())
+                if cached is not None:
+                    return cached
+            except asyncio.TimeoutError:
+                pass  # no push: fall through to an RPC refresh (pubsub is
+                # an optimization, not the source of truth)
+            refresh = True
+        if not refresh:
+            cached = self._actor_states.get(actor_id.hex())
+            if cached is not None:
+                return cached
+        info = await self.gcs_client.call("get_actor",
+                                          actor_id=actor_id.binary())
+        if info is not None:
+            self._actor_states[actor_id.hex()] = info
+        return info
+
+    async def _actor_pubsub_loop(self) -> None:
+        """Long-poll the GCS 'actors' channel (reference: the reference's
+        pubsub had zero subscribers in round 1 — this makes actor-state
+        discovery push-based)."""
+        cursor = 0
+        while not self._shutdown:
+            try:
+                out = await self.gcs_client.call(
+                    "pubsub_poll", cursors={"actors": cursor}, timeout=40.0)
+            except Exception:
+                await asyncio.sleep(0.5)
+                continue
+            for seq, msg in (out or {}).get("actors", []):
+                cursor = max(cursor, seq)
+                view = msg.get("actor") or {}
+                aid = view.get("actor_id")
+                if aid:
+                    self._actor_states[aid] = view
+            if (out or {}).get("actors"):
+                pulse, self._actor_pulse = self._actor_pulse, asyncio.Event()
+                pulse.set()
 
     def unresolved_owned_deps(self, spec: TaskSpec) -> List[ObjectID]:
         """Top-level ref args owned by this process whose values are not yet
@@ -1597,11 +1769,19 @@ class Worker:
     # Object-plane RPC handlers (owner side)
     # ------------------------------------------------------------------
     async def _rpc_get_object(
-        self, object_id: bytes, borrower: Optional[Tuple[str, int]] = None
+        self, object_id: bytes, borrower: Optional[Tuple[str, int]] = None,
+        recover: bool = False,
     ) -> Dict[str, Any]:
         oid = ObjectID(object_id)
         if borrower:
             self.ref_counter.add_borrower(oid, tuple(borrower))
+        if recover:
+            # Borrower observed the object's node gone — re-execute lineage
+            # before answering (owner-driven recovery).
+            try:
+                await self._recover_object(oid, None)
+            except Exception:
+                pass
         entry = self.memory_store.get_if_exists(oid)
         if entry is None:
             if self.shm.contains(oid):

@@ -15,6 +15,8 @@ gcs_actor_scheduler.h:115).
 from __future__ import annotations
 
 import asyncio
+import os
+import pickle
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -58,6 +60,10 @@ class PubsubChannels:
         def _collect() -> Dict[str, List[Tuple[int, Any]]]:
             out: Dict[str, List[Tuple[int, Any]]] = {}
             for channel, cursor in cursors.items():
+                if cursor > self._seq.get(channel, 0):
+                    # Subscriber cursor from a previous GCS incarnation
+                    # (sequences reset on restart): replay from the start.
+                    cursor = 0
                 msgs = [m for m in self._messages.get(channel, []) if m[0] > cursor]
                 if msgs:
                     out[channel] = msgs
@@ -125,6 +131,34 @@ class ActorInfo:
             "death_cause": self.death_cause,
         }
 
+    def to_state(self) -> Dict[str, Any]:
+        return {
+            "actor_id": self.actor_id.binary(),
+            "creation_spec": self.creation_spec,
+            "name": self.name,
+            "max_restarts": self.max_restarts,
+            "detached": self.detached,
+            "state": self.state,
+            "address": self.address,
+            "node_id": self.node_id.binary() if self.node_id else None,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+        }
+
+    @staticmethod
+    def from_state(state: Dict[str, Any]) -> "ActorInfo":
+        info = ActorInfo(ActorID(state["actor_id"]), state["creation_spec"],
+                         state["name"], state["max_restarts"],
+                         state["detached"])
+        info.state = state["state"]
+        info.address = (tuple(state["address"])
+                        if state["address"] else None)
+        info.node_id = (NodeID(state["node_id"])
+                        if state["node_id"] else None)
+        info.num_restarts = state["num_restarts"]
+        info.death_cause = state["death_cause"]
+        return info
+
 
 class PlacementGroupInfo:
     def __init__(self, pg_id: PlacementGroupID, bundles: List[Dict[str, float]],
@@ -141,8 +175,40 @@ class PlacementGroupInfo:
 # ---------------------------------------------------------------------------
 # The server
 # ---------------------------------------------------------------------------
+class GcsStorage:
+    """File-backed table persistence (reference: gcs/store_client/
+    redis_store_client.h — there Redis enables GCS restart; here an atomic
+    pickle snapshot under the session dir does. Snapshots are debounced:
+    mutations mark dirty, a flush loop writes ≤1x per interval, and
+    shutdown flushes synchronously)."""
+
+    def __init__(self, path: Optional[str]):
+        self.path = path
+        self.dirty = False
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        if not self.path or not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path, "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            logger.exception("GCS snapshot unreadable; starting fresh")
+            return None
+
+    def save(self, tables: Dict[str, Any]) -> None:
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(tables, f, protocol=5)
+        os.replace(tmp, self.path)
+        self.dirty = False
+
+
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 persist_path: Optional[str] = None):
         self.server = RpcServer(host, port)
         self.pubsub = PubsubChannels()
         self.nodes: Dict[NodeID, NodeInfo] = {}
@@ -156,6 +222,45 @@ class GcsServer:
         self._background: List[asyncio.Task] = []
         self._actor_locks: Dict[ActorID, asyncio.Lock] = {}
         self._spread_rr = 0
+        self.storage = GcsStorage(persist_path)
+        self._restore()
+
+    def _restore(self) -> None:
+        snap = self.storage.load()
+        if not snap:
+            return
+        self.kv = snap.get("kv", {})
+        self.jobs = snap.get("jobs", {})
+        self._job_counter = snap.get("job_counter", 0)
+        self.named_actors = {n: ActorID(a)
+                             for n, a in snap.get("named_actors", {}).items()}
+        for state in snap.get("actors", []):
+            info = ActorInfo.from_state(state)
+            self.actors[info.actor_id] = info
+        logger.info("GCS restored %d actors, %d kv keys from snapshot",
+                    len(self.actors), len(self.kv))
+
+    def mark_dirty(self) -> None:
+        self.storage.dirty = True
+
+    def _snapshot_tables(self) -> Dict[str, Any]:
+        return {
+            "kv": dict(self.kv),
+            "jobs": dict(self.jobs),
+            "job_counter": self._job_counter,
+            "named_actors": {n: a.binary()
+                             for n, a in self.named_actors.items()},
+            "actors": [a.to_state() for a in self.actors.values()],
+        }
+
+    async def _persist_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.25)
+            if self.storage.dirty:
+                try:
+                    self.storage.save(self._snapshot_tables())
+                except Exception:
+                    logger.exception("GCS snapshot failed")
 
     async def start(self) -> Tuple[str, int]:
         for name in dir(self):
@@ -164,6 +269,9 @@ class GcsServer:
         addr = await self.server.start()
         self._background.append(asyncio.ensure_future(self._health_check_loop()))
         self._background.append(asyncio.ensure_future(self._pg_retry_loop()))
+        if self.storage.path:
+            self._background.append(
+                asyncio.ensure_future(self._persist_loop()))
         logger.info("GCS listening on %s:%d", *addr)
         return addr
 
@@ -172,6 +280,11 @@ class GcsServer:
             t.cancel()
         for c in self._nodelet_clients.values():
             await c.close()
+        if self.storage.path and self.storage.dirty:
+            try:
+                self.storage.save(self._snapshot_tables())
+            except Exception:
+                pass
         await self.server.stop()
 
     def _nodelet(self, node_id: NodeID) -> RpcClient:
@@ -263,6 +376,7 @@ class GcsServer:
         if not overwrite and key in self.kv:
             return False
         self.kv[key] = value
+        self.mark_dirty()
         return True
 
     async def rpc_kv_get(self, key: str) -> Optional[bytes]:
@@ -283,6 +397,7 @@ class GcsServer:
             "job_id": self._job_counter, "start_time": time.time(),
             "state": "RUNNING", **metadata,
         }
+        self.mark_dirty()
         return self._job_counter
 
     async def rpc_finish_job(self, job_id: int) -> None:
@@ -377,8 +492,10 @@ class GcsServer:
                 return {"ok": False,
                         "error": f"actor name {name!r} already taken"}
             self.named_actors[name] = aid
+            self.mark_dirty()
         info = ActorInfo(aid, creation_spec, name, max_restarts, detached)
         self.actors[aid] = info
+        self.mark_dirty()
         asyncio.ensure_future(self._schedule_actor(info))
         return {"ok": True}
 
@@ -454,6 +571,7 @@ class GcsServer:
                         info, f"creation failed: {result.get('error')}")
                     return
                 info.state = ACTOR_ALIVE
+                self.mark_dirty()
                 info.address = worker_addr
                 info.node_id = node.node_id
                 await self.pubsub.publish(
@@ -472,6 +590,7 @@ class GcsServer:
 
     async def _actor_dead(self, info: ActorInfo, cause: str) -> None:
         info.state = ACTOR_DEAD
+        self.mark_dirty()
         info.death_cause = cause
         info.address = None
         if info.name:
@@ -489,6 +608,7 @@ class GcsServer:
             if info.max_restarts == -1 or info.num_restarts < info.max_restarts:
                 info.num_restarts += 1
                 info.state = ACTOR_RESTARTING
+                self.mark_dirty()
                 info.address = None
                 await self.pubsub.publish(
                     "actors", {"event": "restarting",
@@ -684,8 +804,9 @@ class GcsServer:
         return "pong"
 
 
-async def run_gcs_server(host: str, port: int) -> GcsServer:
-    gcs = GcsServer(host, port)
+async def run_gcs_server(host: str, port: int,
+                         persist_path: Optional[str] = None) -> GcsServer:
+    gcs = GcsServer(host, port, persist_path=persist_path)
     await gcs.start()
     return gcs
 
@@ -696,10 +817,12 @@ def main() -> None:  # pragma: no cover - exercised via subprocess
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--persist-path", default=None)
     args = parser.parse_args()
 
     async def _run():
-        await run_gcs_server(args.host, args.port)
+        await run_gcs_server(args.host, args.port,
+                             persist_path=args.persist_path)
         await asyncio.Event().wait()
 
     asyncio.run(_run())

@@ -88,6 +88,7 @@ class WorkerHandle:
         self.resources: Optional[ResourceSet] = None
         self.pg_bundle: Optional[Tuple[bytes, int]] = None
         self.last_idle = time.monotonic()
+        self.tpu_chips: List[int] = []
 
 
 class Nodelet:
@@ -111,6 +112,11 @@ class Nodelet:
 
         self.resources_total = dict(resources or detect_resources())
         self.resources_available = dict(self.resources_total)
+        # TPU chip accounting for visibility enforcement (reference:
+        # _private/accelerators/tpu.py:110 TPU_VISIBLE_CHIPS): whole-chip
+        # leases get disjoint chip ids; fractional leases share chip 0.
+        self._tpu_chips_free = list(range(int(
+            self.resources_total.get("TPU", 0))))
         cfg = get_config()
         store_capacity = object_store_memory or cfg.object_store_memory
         os.makedirs(session_dir, exist_ok=True)
@@ -184,9 +190,13 @@ class Nodelet:
     # ------------------------------------------------------------------
     def _spawn_worker(self, env_key: str,
                       runtime_env: Optional[Dict[str, Any]],
-                      needs_tpu: bool = False) -> WorkerHandle:
+                      needs_tpu: bool = False,
+                      tpu_chips: Optional[List[int]] = None) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        if needs_tpu and tpu_chips:
+            env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, tpu_chips))
+            env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,1,{len(tpu_chips)}"
         if not needs_tpu:
             # Workers without a TPU lease start WITHOUT the TPU plumbing:
             # the site hook imports jax at interpreter start (~2s of the
@@ -235,7 +245,7 @@ class Nodelet:
 
     async def _get_idle_worker(
         self, env_key: str, runtime_env: Optional[Dict[str, Any]],
-        needs_tpu: bool = False,
+        needs_tpu: bool = False, tpu_chips: Optional[List[int]] = None,
     ) -> WorkerHandle:
         """Returns a worker already marked leased — reserving at selection
         time closes the race where two lease requests pick the same worker
@@ -246,7 +256,8 @@ class Nodelet:
                     and w.proc.poll() is None):
                 w.leased = True
                 return w
-        handle = self._spawn_worker(env_key, runtime_env, needs_tpu)
+        handle = self._spawn_worker(env_key, runtime_env, needs_tpu,
+                                    tpu_chips)
         handle.leased = True
         try:
             await asyncio.wait_for(handle.ready.wait(),
@@ -272,7 +283,8 @@ class Nodelet:
         block: bool = True,
     ) -> Dict[str, Any]:
         req = ResourceSet(resources)
-        needs_tpu = float(resources.get("TPU", 0) or 0) > 0
+        num_tpus = float(resources.get("TPU", 0) or 0)
+        needs_tpu = num_tpus > 0
         env_key = repr(sorted((runtime_env or {}).items())) + (
             "|tpu" if needs_tpu else "")
         cfg = get_config()
@@ -283,16 +295,29 @@ class Nodelet:
                 return {"ok": False, "error": "unknown placement bundle"}
             if req.fits_in(pool):
                 req.subtract_from(pool)
+                # Disjoint chip assignment per whole-chip lease; fractional
+                # leases share chip 0 (reference: tpu.py visibility).
+                chips: List[int] = []
+                if needs_tpu:
+                    if num_tpus >= 1 and self._tpu_chips_free:
+                        chips = sorted(self._tpu_chips_free[-int(num_tpus):])
+                        del self._tpu_chips_free[-int(num_tpus):]
+                    else:
+                        chips = [0]
+                    env_key += f"|chips:{','.join(map(str, chips))}"
                 try:
                     worker = await self._get_idle_worker(env_key, runtime_env,
-                                                         needs_tpu)
+                                                         needs_tpu, chips)
                 except Exception as e:
                     req.add_to(pool)
+                    if num_tpus >= 1:
+                        self._tpu_chips_free.extend(chips)
                     return {"ok": False, "error": f"worker start failed: {e!r}"}
                 worker.leased = True
                 worker.lifetime = lifetime
                 worker.resources = req
                 worker.pg_bundle = pg_bundle
+                worker.tpu_chips = chips if num_tpus >= 1 else []
                 return {
                     "ok": True,
                     "worker_id": worker.worker_id.binary(),
@@ -334,6 +359,9 @@ class Nodelet:
             if pool is not None:
                 worker.resources.add_to(pool)
             worker.resources = None
+        if worker.tpu_chips:
+            self._tpu_chips_free.extend(worker.tpu_chips)
+            worker.tpu_chips = []
         worker.leased = False
         worker.last_idle = time.monotonic()
         self._wake_lease_waiters()
@@ -389,6 +417,17 @@ class Nodelet:
             "resources_available": dict(self.resources_available),
             "num_workers": len(self.workers),
             "num_leased": sum(1 for w in self.workers.values() if w.leased),
+            "workers": [
+                {
+                    "worker_id": w.worker_id.hex(),
+                    "pid": w.proc.pid,
+                    "leased": w.leased,
+                    "lifetime": w.lifetime,
+                    "address": w.address,
+                    "tpu_chips": list(w.tpu_chips),
+                }
+                for w in self.workers.values()
+            ],
             "store": self.store.stats(),
             "store_path": self.store_path,
             "bundles": {
@@ -459,6 +498,9 @@ class Nodelet:
                         pool = self._bundle_pool(getattr(w, "pg_bundle", None))
                         if pool is not None:
                             w.resources.add_to(pool)
+                    if w.tpu_chips:
+                        self._tpu_chips_free.extend(w.tpu_chips)
+                        w.tpu_chips = []
                     self._wake_lease_waiters()
                     if w.leased:
                         try:

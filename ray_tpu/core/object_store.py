@@ -325,7 +325,10 @@ class MemoryStore:
 
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:
-            self._objects.pop(object_id, None)
+            obj = self._objects.pop(object_id, None)
+        # Destroy outside the lock: a value holding ObjectRefs cascades into
+        # ref-count callbacks that may re-enter this store.
+        del obj
 
     def size(self) -> int:
         with self._lock:

@@ -50,22 +50,38 @@ class ProxyActor:
                     None, lambda: ray_tpu.get_actor(CONTROLLER_NAME))
             except Exception:
                 await asyncio.sleep(1.0)
+        self._controller = controller
         while True:
             try:
-                routing = await controller.get_routing.remote(self._version)
-                if routing is not None:
-                    self._version = routing["version"]
-                    routes = {}
-                    for name, info in routing["deployments"].items():
-                        prefix = info.get("route_prefix")
-                        if prefix:
-                            routes[prefix] = name
-                            if name not in self._handles:
-                                self._handles[name] = DeploymentHandle(name)
-                    self._routes = routes
+                self._apply_routing(
+                    await controller.get_routing.remote(self._version))
             except Exception:
                 logger.exception("route refresh failed")
             await asyncio.sleep(1.0)
+
+    def _apply_routing(self, routing) -> None:
+        from ray_tpu.serve._handle import DeploymentHandle
+
+        if routing is None:
+            return
+        self._version = routing["version"]
+        routes = {}
+        for name, info in routing["deployments"].items():
+            prefix = info.get("route_prefix")
+            if prefix:
+                routes[prefix] = name
+                if name not in self._handles:
+                    self._handles[name] = DeploymentHandle(name)
+        self._routes = routes
+
+    async def _force_refresh(self) -> None:
+        controller = getattr(self, "_controller", None)
+        if controller is None:
+            return
+        try:
+            self._apply_routing(await controller.get_routing.remote(-1))
+        except Exception:
+            logger.exception("forced route refresh failed")
 
     # ------------------------------------------------------------------
     async def _on_conn(self, reader: asyncio.StreamReader,
@@ -117,6 +133,11 @@ class ProxyActor:
             await self._respond(writer, 200, b"ok")
             return True
         match = self._match(path)
+        if match is None:
+            # The periodic refresh may lag a just-deployed app — check the
+            # controller once before 404ing.
+            await self._force_refresh()
+            match = self._match(path)
         if match is None:
             await self._respond(writer, 404, b"no route")
             return True

@@ -1,0 +1,101 @@
+"""State API: list/summarize cluster entities (reference:
+python/ray/util/state/api.py — there backed by the dashboard StateHead; here
+straight off the GCS tables + per-node nodelet stats)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+def _gcs(method: str, **kwargs) -> Any:
+    w = worker_mod.global_worker()
+    return w.loop_thread.run(w.gcs_client.call(method, **kwargs))
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    out = []
+    for n in _gcs("list_nodes"):
+        out.append({
+            "node_id": n["node_id"].hex() if isinstance(n["node_id"], bytes)
+            else n["node_id"],
+            "address": tuple(n["address"]),
+            "alive": n["alive"],
+            "resources_total": n.get("resources_total", {}),
+            "resources_available": n.get("resources_available", {}),
+            "labels": n.get("labels", {}),
+        })
+    return out
+
+
+def list_actors(*, state: Optional[str] = None) -> List[Dict[str, Any]]:
+    actors = _gcs("list_actors")
+    if state is not None:
+        actors = [a for a in actors if a["state"] == state]
+    return actors
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return _gcs("list_jobs")
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    return _gcs("list_placement_groups")
+
+
+def list_workers() -> List[Dict[str, Any]]:
+    """Per-node worker processes (aggregated from each nodelet)."""
+    import asyncio
+
+    w = worker_mod.global_worker()
+
+    async def _collect():
+        nodes = await w.gcs_client.call("list_nodes")
+        out = []
+        for n in nodes:
+            if not n["alive"]:
+                continue
+            try:
+                client = await w.nodelet_client_for_node(n["node_id"])
+                stats = await asyncio.wait_for(
+                    client.call("node_stats"), 10)
+            except Exception:
+                continue
+            for wk in stats.get("workers", []):
+                wk = dict(wk)
+                wk["node_id"] = n["node_id"].hex()
+                out.append(wk)
+        return out
+
+    return w.loop_thread.run(_collect())
+
+
+def summarize_actors() -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for a in list_actors():
+        counts[a["state"]] = counts.get(a["state"], 0) + 1
+    return counts
+
+
+def cluster_summary() -> Dict[str, Any]:
+    """`ray status`-style overview."""
+    nodes = list_nodes()
+    total: Dict[str, float] = {}
+    avail: Dict[str, float] = {}
+    for n in nodes:
+        if not n["alive"]:
+            continue
+        for k, v in n["resources_total"].items():
+            total[k] = total.get(k, 0) + v
+        for k, v in n["resources_available"].items():
+            avail[k] = avail.get(k, 0) + v
+    return {
+        "nodes_alive": sum(1 for n in nodes if n["alive"]),
+        "nodes_dead": sum(1 for n in nodes if not n["alive"]),
+        "resources_total": total,
+        "resources_available": avail,
+        "actors": summarize_actors(),
+        "placement_groups": len(list_placement_groups()),
+        "jobs": len(list_jobs()),
+    }

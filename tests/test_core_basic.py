@@ -124,3 +124,27 @@ def test_parallelism(ray_start_regular):
 def test_cluster_resources(ray_start_regular):
     total = ray_tpu.cluster_resources()
     assert total.get("CPU") == 8.0
+
+
+def test_state_api(ray_start_regular):
+    """ray_tpu.util.state list/summarize (reference: ray.util.state API)."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Pinned:
+        def ping(self):
+            return "ok"
+
+    a = Pinned.options(name="state-probe").remote()
+    assert ray_tpu.get(a.ping.remote()) == "ok"
+
+    nodes = state.list_nodes()
+    assert nodes and nodes[0]["alive"]
+    actors = state.list_actors(state="ALIVE")
+    assert any(x.get("name") == "state-probe" for x in actors)
+    workers = state.list_workers()
+    assert workers and all("pid" in w for w in workers)
+    summary = state.cluster_summary()
+    assert summary["nodes_alive"] >= 1
+    assert summary["actors"].get("ALIVE", 0) >= 1
+    assert summary["resources_total"].get("CPU", 0) >= 8

@@ -84,3 +84,50 @@ def test_actor_creation_failure_surfaces(ray_start_regular):
     b = BadInit.remote()
     with pytest.raises(ray_tpu.RayTpuError):
         ray_tpu.get(b.f.remote(), timeout=60)
+
+
+
+CHAOS_SCRIPT = """
+import os
+os.environ["RAY_TPU_TESTING_RPC_FAILURE"] = (
+    "push_task:0.1,push_task_batch:0.1,lease_worker:0.05")
+import ray_tpu
+
+ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+
+@ray_tpu.remote
+def work(i):
+    return i * i
+
+# Retries must absorb a 10% injected failure rate on the push path.
+vals = ray_tpu.get([work.options(max_retries=20).remote(i)
+                    for i in range(100)], timeout=240)
+assert vals == [i * i for i in range(100)], vals[:5]
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def add(self):
+        self.n += 1
+        return self.n
+
+c = Counter.remote()
+out = ray_tpu.get([c.add.remote() for _ in range(50)], timeout=240)
+assert out[-1] == 50, out[-5:]
+print("CHAOS_OK", flush=True)
+ray_tpu.shutdown()
+"""
+
+
+def test_rpc_chaos_injection_absorbed_by_retries():
+    """Fault-injected control plane (reference: rpc_chaos.h wired into
+    test_gcs_fault_tolerance.py): 10% push failures + 5% lease failures
+    must not surface to the application."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", CHAOS_SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=420)
+    assert "CHAOS_OK" in out.stdout, out.stdout[-800:] + out.stderr[-2000:]
