@@ -20,6 +20,12 @@ class ActorMethod:
     def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
         return ActorMethod(self._handle, self._method_name, num_returns)
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node from this method (reference: dag/dag_node.py)."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def remote(self, *args, **kwargs):
         w = worker_mod.global_worker()
         num_returns = self._num_returns

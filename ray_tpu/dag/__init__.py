@@ -1,0 +1,142 @@
+"""ray_tpu.dag — compiled actor DAGs (reference: python/ray/dag —
+`DAGNode.experimental_compile` dag/dag_node.py:265, `CompiledDAG`
+compiled_dag_node.py:808).
+
+Redesign rationale (TPU-first, not a port): the reference's compiled DAGs
+exist to bypass per-call submission overhead and to move GPU tensors over
+NCCL channels between pinned per-actor loops. In this runtime those two
+jobs are covered differently:
+- submission is already a direct actor push (no raylet hop, batched and
+  pipelined), so "compile" here means pre-resolving the graph once —
+  topological order, argument wiring, handle caches — and replaying it
+  per execute() with zero graph work;
+- high-bandwidth device-to-device movement on TPU belongs INSIDE jitted
+  programs (ICI collectives via shard_map/pjit), so a multi-chip pipeline
+  stage is a jitted program on its actor, and the DAG moves host-side
+  values/refs between stages (the object plane), exactly like the
+  reference's CPU channels.
+
+Execution is dataflow: each stage's call takes upstream ObjectRefs as args;
+executes pipeline across stages because actor pushes are async and ordered
+per submitter.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+
+
+class DAGNode:
+    """Base graph node."""
+
+    def __init__(self, args: Tuple = (), kwargs: Optional[Dict] = None):
+        self.args = args
+        self.kwargs = kwargs or {}
+
+    def experimental_compile(self, **_opts) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+    def execute(self, *args, **kwargs):
+        """Eager one-shot execution (compiles implicitly)."""
+        return self.experimental_compile().execute(*args, **kwargs)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the per-execution input (reference: dag/input_node.py).
+
+    Supports `with InputNode() as inp:` for API parity."""
+
+    def __init__(self):
+        super().__init__()
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+
+class ClassMethodNode(DAGNode):
+    """One bound actor-method call in the graph."""
+
+    def __init__(self, actor_handle, method_name: str, args: Tuple,
+                 kwargs: Dict):
+        super().__init__(args, kwargs)
+        self.actor_handle = actor_handle
+        self.method_name = method_name
+
+
+class MultiOutputNode(DAGNode):
+    """Gathers several leaf nodes into one output tuple."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+        self.outputs = list(outputs)
+
+
+class CompiledDAG:
+    """Pre-resolved executable graph (reference: compiled_dag_node.py:808)."""
+
+    def __init__(self, output_node: DAGNode):
+        self._output = output_node
+        self._order: List[ClassMethodNode] = []
+        self._input_nodes: List[InputNode] = []
+        self._visited: set = set()
+        self._walk(output_node)
+        if not self._input_nodes:
+            raise ValueError("DAG has no InputNode")
+        self._executions = 0
+
+    def _walk(self, node: DAGNode) -> None:
+        if id(node) in self._visited:
+            return
+        self._visited.add(id(node))
+        for a in list(node.args) + list(node.kwargs.values()):
+            if isinstance(a, DAGNode):
+                self._walk(a)
+        if isinstance(node, InputNode):
+            self._input_nodes.append(node)
+        elif isinstance(node, ClassMethodNode):
+            self._order.append(node)  # post-order == topological
+
+    def execute(self, *input_args, **input_kwargs):
+        """Submit one wave through the graph; returns the output ref (or a
+        tuple of refs for MultiOutputNode). Multiple executes pipeline —
+        per-actor ordering comes from the actor push queues."""
+        if len(input_args) == 1 and not input_kwargs:
+            input_val: Any = input_args[0]
+        elif input_kwargs and not input_args:
+            input_val = input_kwargs
+        else:
+            input_val = input_args
+        self._executions += 1
+        results: Dict[int, Any] = {}
+
+        def resolve(a):
+            if isinstance(a, InputNode):
+                return input_val
+            if isinstance(a, DAGNode):
+                return results[id(a)]
+            return a
+
+        for node in self._order:
+            args = tuple(resolve(a) for a in node.args)
+            kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+            method = getattr(node.actor_handle, node.method_name)
+            results[id(node)] = method.remote(*args, **kwargs)
+
+        out = self._output
+        if isinstance(out, MultiOutputNode):
+            return tuple(results[id(n)] for n in out.outputs)
+        return results[id(out)]
+
+    def teardown(self) -> None:
+        self._order.clear()
+        self._visited.clear()
+
+
+__all__ = ["CompiledDAG", "ClassMethodNode", "DAGNode", "InputNode",
+           "MultiOutputNode"]
