@@ -1,0 +1,112 @@
+"""Runtime environments: per-task/actor working_dir, py_modules, env_vars
+(reference: python/ray/_private/runtime_env/ — the plugin set there includes
+pip/uv/conda; here the offline-capable core: code shipping via the GCS KV,
+like function export, extracted per node and activated per worker).
+
+Driver side: `prepare()` zips local dirs, content-addresses them, uploads to
+the GCS KV once, and rewrites the runtime_env to reference the keys.
+Node side: `materialize()` downloads + extracts under the session dir (once
+per content hash) and returns the env-var deltas for the worker spawn."""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import zipfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_prepared_cache: Dict[str, Tuple[str, str]] = {}  # abs path -> (key, hash)
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs
+                       if d not in ("__pycache__", ".git", ".venv")]
+            for f in files:
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, path))
+    return buf.getvalue()
+
+
+def _upload_dir(path: str, gcs_call: Callable) -> Tuple[str, str]:
+    """Zip + upload one directory; returns (kv_key, content_hash)."""
+    path = os.path.abspath(path)
+    cached = _prepared_cache.get(path)
+    if cached is not None:
+        return cached
+    payload = _zip_dir(path)
+    digest = hashlib.sha1(payload).hexdigest()
+    key = f"runtime_env:{digest}"
+    gcs_call("kv_put", key=key, value=payload, overwrite=False)
+    _prepared_cache[path] = (key, digest)
+    return key, digest
+
+
+def prepare(runtime_env: Optional[Dict[str, Any]],
+            gcs_call: Callable) -> Optional[Dict[str, Any]]:
+    """Driver side: rewrite local paths into KV references."""
+    if not runtime_env:
+        return runtime_env
+    out = dict(runtime_env)
+    wd = out.get("working_dir")
+    if isinstance(wd, str):
+        key, digest = _upload_dir(wd, gcs_call)
+        out["working_dir"] = {"kv": key, "hash": digest}
+    mods = out.get("py_modules")
+    if mods:
+        packed: List[Any] = []
+        for m in mods:
+            if isinstance(m, str):
+                key, digest = _upload_dir(m, gcs_call)
+                packed.append({"kv": key, "hash": digest,
+                               "name": os.path.basename(os.path.abspath(m))})
+            else:
+                packed.append(m)
+        out["py_modules"] = packed
+    return out
+
+
+async def materialize(runtime_env: Optional[Dict[str, Any]],
+                      gcs_client, base_dir: str) -> Dict[str, str]:
+    """Node side: extract referenced archives; returns env-var deltas
+    (RAY_TPU_WORKING_DIR + PYTHONPATH prefix entries)."""
+    env: Dict[str, str] = {}
+    if not runtime_env:
+        return env
+    pythonpath_add: List[str] = []
+
+    async def fetch_extract(ref: Dict[str, Any],
+                            nested_name: Optional[str] = None) -> str:
+        dest = os.path.join(base_dir, ref["hash"])
+        if not os.path.isdir(dest):
+            payload = await gcs_client.call("kv_get", key=ref["kv"])
+            if payload is None:
+                raise RuntimeError(f"runtime env blob {ref['kv']} missing")
+            tmp = dest + ".tmp"
+            target = os.path.join(tmp, nested_name) if nested_name else tmp
+            os.makedirs(target, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(bytes(payload))) as z:
+                z.extractall(target)
+            os.replace(tmp, dest)
+        return dest
+
+    wd = runtime_env.get("working_dir")
+    if isinstance(wd, dict):
+        path = await fetch_extract(wd)
+        env["RAY_TPU_WORKING_DIR"] = path
+        pythonpath_add.append(path)
+    for m in runtime_env.get("py_modules") or []:
+        if isinstance(m, dict):
+            # Extract under <hash>/<name> so `import <name>` works.
+            path = await fetch_extract(m, nested_name=m.get("name"))
+            pythonpath_add.append(path)
+    if pythonpath_add:
+        env["RAY_TPU_PYTHONPATH_PREPEND"] = os.pathsep.join(pythonpath_add)
+    return env

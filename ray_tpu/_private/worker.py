@@ -55,6 +55,7 @@ from ray_tpu._private.task_spec import (
 from ray_tpu.core.object_store import MemoryStore, SharedMemoryStore
 from ray_tpu.exceptions import (
     ActorDiedError,
+    ObjectStoreFullError,
     ActorUnavailableError,
     GetTimeoutError,
     ObjectLostError,
@@ -469,6 +470,14 @@ class ActorSubmitter:
             asyncio.ensure_future(client.close())
 
 
+def _prepare_runtime_env(runtime_env, gcs_call):
+    if not runtime_env:
+        return runtime_env
+    from ray_tpu._private import runtime_env as rt_env
+
+    return rt_env.prepare(runtime_env, gcs_call)
+
+
 def ser_spec(spec: TaskSpec) -> bytes:
     import pickle
 
@@ -501,6 +510,9 @@ class Worker:
         self.loop = self.loop_thread.loop
         self.memory_store = MemoryStore(self.loop)
         self.shm = SharedMemoryStore(store_path)
+        # Spill-before-evict: the arena must not silently drop objects under
+        # pressure — put_shm_or_spill moves the LRU victim to disk first.
+        self.shm.set_auto_evict(False)
         self.ref_counter = ReferenceCounter(on_zero=self._on_owned_ref_zero)
         self.task_manager = TaskManager(self._store_task_result)
         self.server = RpcServer()
@@ -645,6 +657,52 @@ class Worker:
     # ------------------------------------------------------------------
     # Owned-object lifecycle
     # ------------------------------------------------------------------
+    @property
+    def spill_dir(self) -> str:
+        return os.path.join(self.session_dir, "spill", self.node_id.hex())
+
+    def put_shm_or_spill(self, object_id: ObjectID,
+                         obj: ser.SerializedObject) -> None:
+        """Store in shm; on arena pressure, spill LRU victims to the node's
+        spill dir until the new object fits (reference:
+        local_object_manager.h — spill-before-evict so nothing is silently
+        dropped; readers fall back to the spill files transparently)."""
+        from ray_tpu.core.object_store import spill_write
+
+        try:
+            self.shm.put_serialized(object_id, obj)
+            return
+        except ObjectStoreFullError:
+            pass
+        last_victim = None
+        while True:
+            victim = self.shm.lru_candidate()
+            if victim is None or victim == last_victim:
+                break
+            last_victim = victim
+            vobj = self.shm.get_serialized(victim)
+            if vobj is not None:
+                spill_write(self.spill_dir, victim, vobj)
+                del vobj  # drop the read pin before deleting
+            logger.info("shm pressure: spilled %s to disk", victim)
+            self.shm.delete(victim)
+            try:
+                self.shm.put_serialized(object_id, obj)
+                return
+            except ObjectStoreFullError:
+                continue
+        # Nothing evictable (or object larger than the arena): spill the
+        # new object itself.
+        logger.warning("shm full; spilling %s (%d bytes) to disk",
+                       object_id, obj.total_bytes())
+        spill_write(self.spill_dir, object_id, obj)
+
+    def read_spilled(self, object_id: ObjectID
+                     ) -> Optional[ser.SerializedObject]:
+        from ray_tpu.core.object_store import spill_read
+
+        return spill_read(self.spill_dir, object_id)
+
     def _on_owned_ref_zero(self, object_id: ObjectID) -> None:
         self.memory_store.delete(object_id)
         self.task_manager.drop_lineage(object_id)
@@ -652,6 +710,9 @@ class Worker:
             self.shm.delete(object_id)
         except Exception:
             pass
+        from ray_tpu.core.object_store import spill_delete
+
+        spill_delete(self.spill_dir, object_id)
 
     def _store_task_result(self, object_id: ObjectID, result: Any) -> None:
         """TaskManager completion callback: result is SerializedObject or
@@ -670,7 +731,7 @@ class Worker:
         obj = ser.serialize(value)
         cfg = get_config()
         if obj.total_bytes() > cfg.max_inline_object_size:
-            self.shm.put_serialized(object_id, obj)
+            self.put_shm_or_spill(object_id, obj)
             self.memory_store.put(object_id, ShmMarker(self.node_id.binary()))
         else:
             self.memory_store.put(object_id, obj)
@@ -797,6 +858,9 @@ class Worker:
         assert isinstance(entry, ShmMarker)
         if entry.node_id == self.node_id.binary() or self.shm.contains(object_id):
             obj = self.shm.get_serialized(object_id)
+            if obj is not None:
+                return obj
+            obj = self.read_spilled(object_id)
             if obj is not None:
                 return obj
             raise ObjectLostError(f"object {object_id} missing from local shm "
@@ -1009,7 +1073,8 @@ class Worker:
             max_retries=cfg.task_max_retries if max_retries is None else max_retries,
             retry_exceptions=retry_exceptions,
             owner_address=self.address,
-            runtime_env=runtime_env,
+            runtime_env=_prepare_runtime_env(runtime_env,
+                                              self._gcs_call_sync),
         )
         return_ids = self.task_manager.add_pending(spec)
         if num_returns == -1:
@@ -1347,7 +1412,8 @@ class Worker:
             max_concurrency=max_concurrency,
             max_restarts=max_restarts,
             max_task_retries=max_task_retries,
-            runtime_env=runtime_env,
+            runtime_env=_prepare_runtime_env(runtime_env,
+                                              self._gcs_call_sync),
         )
         reply = self.loop_thread.run(
             self.gcs_client.call_retrying(
@@ -1651,7 +1717,7 @@ class Worker:
             obj = ser.serialize(v)
             if obj.total_bytes() > cfg.max_inline_object_size:
                 oid = ObjectID.for_task_return(spec.task_id, i)
-                self.shm.put_serialized(oid, obj)
+                self.put_shm_or_spill(oid, obj)
                 out.append(("shm", self.node_id.binary()))
             else:
                 out.append(("inline", obj.metadata,
@@ -1723,7 +1789,7 @@ class Worker:
                 obj = ser.serialize(value)
                 if obj.total_bytes() > cfg.max_inline_object_size:
                     oid = ObjectID.for_task_return(spec.task_id, idx)
-                    self.shm.put_serialized(oid, obj)
+                    self.put_shm_or_spill(oid, obj)
                     item: Tuple = ("shm", self.node_id.binary())
                 else:
                     item = ("inline", obj.metadata,

@@ -22,6 +22,10 @@ def main() -> None:
     session_dir = os.environ["RAY_TPU_SESSION_DIR"]
     node_id = NodeID.from_hex(os.environ["RAY_TPU_NODE_ID"])
 
+    working_dir = os.environ.get("RAY_TPU_WORKING_DIR")
+    if working_dir and os.path.isdir(working_dir):
+        os.chdir(working_dir)  # runtime_env working_dir activation
+
     worker = Worker(
         mode="worker",
         gcs_address=(gcs_host, int(gcs_port)),

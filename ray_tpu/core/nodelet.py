@@ -191,9 +191,12 @@ class Nodelet:
     def _spawn_worker(self, env_key: str,
                       runtime_env: Optional[Dict[str, Any]],
                       needs_tpu: bool = False,
-                      tpu_chips: Optional[List[int]] = None) -> WorkerHandle:
+                      tpu_chips: Optional[List[int]] = None,
+                      env_updates: Optional[Dict[str, str]] = None
+                      ) -> WorkerHandle:
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        env.update(env_updates or {})
         if needs_tpu and tpu_chips:
             env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, tpu_chips))
             env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,1,{len(tpu_chips)}"
@@ -216,6 +219,9 @@ class Nodelet:
         repo_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        prepend = env.pop("RAY_TPU_PYTHONPATH_PREPEND", "")
+        if prepend:
+            env["PYTHONPATH"] = prepend + os.pathsep + env["PYTHONPATH"]
         if runtime_env:
             for k, v in (runtime_env.get("env_vars") or {}).items():
                 env[k] = v
@@ -256,8 +262,16 @@ class Nodelet:
                     and w.proc.poll() is None):
                 w.leased = True
                 return w
+        env_updates: Dict[str, str] = {}
+        if runtime_env and (runtime_env.get("working_dir")
+                            or runtime_env.get("py_modules")):
+            from ray_tpu._private.runtime_env import materialize
+
+            env_updates = await materialize(
+                runtime_env, self._gcs,
+                os.path.join(self.session_dir, "runtime_envs"))
         handle = self._spawn_worker(env_key, runtime_env, needs_tpu,
-                                    tpu_chips)
+                                    tpu_chips, env_updates)
         handle.leased = True
         try:
             await asyncio.wait_for(handle.ready.wait(),
@@ -444,6 +458,11 @@ class Nodelet:
 
         oid = ObjectID(object_id)
         obj = self.store.get_serialized(oid)
+        if obj is None:
+            from ray_tpu.core.object_store import spill_read
+
+            obj = spill_read(os.path.join(
+                self.session_dir, "spill", self.node_id.hex()), oid)
         if obj is None:
             return None
         # The read pin auto-releases when obj's buffers are dropped.

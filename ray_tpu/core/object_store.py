@@ -79,6 +79,10 @@ def _load_native():
     lib.shm_store_prefault.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.shm_store_prefault_done.restype = ctypes.c_int
     lib.shm_store_prefault_done.argtypes = [ctypes.c_void_p]
+    lib.shm_store_set_auto_evict.restype = None
+    lib.shm_store_set_auto_evict.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.shm_store_lru_candidate.restype = ctypes.c_int
+    lib.shm_store_lru_candidate.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shm_store_write.restype = None
     lib.shm_store_write.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
@@ -181,6 +185,16 @@ class SharedMemoryStore:
 
     def contains(self, object_id: ObjectID) -> bool:
         return bool(self._lib.shm_store_contains(self._handle, object_id.binary()))
+
+    def set_auto_evict(self, enabled: bool) -> None:
+        self._lib.shm_store_set_auto_evict(self._handle, 1 if enabled else 0)
+
+    def lru_candidate(self) -> Optional[ObjectID]:
+        buf = ctypes.create_string_buffer(20)
+        rc = self._lib.shm_store_lru_candidate(self._handle, buf)
+        if rc != SHM_OK:
+            return None
+        return ObjectID(buf.raw)
 
     def delete(self, object_id: ObjectID) -> None:
         self._lib.shm_store_delete(self._handle, object_id.binary())
@@ -333,3 +347,54 @@ class MemoryStore:
     def size(self) -> int:
         with self._lock:
             return len(self._objects)
+
+
+# ---------------------------------------------------------------------------
+# Spilling (reference: src/ray/raylet/local_object_manager.h + external
+# storage). Redesign: overflow spilling — an object that does not fit the
+# arena is written to a per-node spill directory in the same framed format;
+# readers (worker materialize + nodelet fetch) fall back to it transparently.
+# ---------------------------------------------------------------------------
+def spill_path(spill_dir: str, object_id: ObjectID) -> str:
+    return os.path.join(spill_dir, object_id.hex())
+
+
+def spill_write(spill_dir: str, object_id: ObjectID,
+                obj: SerializedObject) -> str:
+    os.makedirs(spill_dir, exist_ok=True)
+    path = spill_path(spill_dir, object_id)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(struct.pack(">I", len(obj.metadata)))
+        f.write(obj.metadata)
+        f.write(struct.pack(">I", len(obj.buffers)))
+        for buf in obj.buffers:
+            f.write(struct.pack(">Q", len(buf)))
+            f.write(buf)
+    os.replace(tmp, path)
+    return path
+
+
+def spill_read(spill_dir: str, object_id: ObjectID
+               ) -> Optional[SerializedObject]:
+    path = spill_path(spill_dir, object_id)
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    (mlen,) = struct.unpack_from(">I", data, off); off += 4
+    metadata = data[off:off + mlen]; off += mlen
+    (nbuf,) = struct.unpack_from(">I", data, off); off += 4
+    buffers = []
+    for _ in range(nbuf):
+        (blen,) = struct.unpack_from(">Q", data, off); off += 8
+        buffers.append(data[off:off + blen]); off += blen
+    return SerializedObject(bytes(metadata), buffers, [])
+
+
+def spill_delete(spill_dir: str, object_id: ObjectID) -> None:
+    try:
+        os.remove(spill_path(spill_dir, object_id))
+    except OSError:
+        pass

@@ -73,6 +73,10 @@ struct Header {
   uint64_t lru_clock;
   uint64_t bytes_in_use;
   uint64_t num_objects;
+  // 1 = create() may silently LRU-evict unpinned sealed objects (default);
+  // 0 = create() returns SHM_ERR_FULL instead, so the client can spill the
+  // LRU candidate to disk first (spill-before-evict).
+  uint64_t auto_evict;
   pthread_mutex_t mutex;
 };
 
@@ -290,6 +294,7 @@ void* shm_store_create(const char* path, uint64_t capacity) {
   b->next_off = 0;
   h->free_head = kAlign;
   h->bytes_in_use = 0;
+  h->auto_evict = 1;
 
   pthread_mutexattr_t attr;
   pthread_mutexattr_init(&attr);
@@ -353,7 +358,7 @@ int shm_store_create_object(void* handle, const uint8_t* id, uint64_t size,
   if (find(s, id)) return SHM_ERR_EXISTS;
   uint64_t off = alloc(s, size);
   while (off == UINT64_MAX) {
-    if (!evict_one(s)) return SHM_ERR_FULL;
+    if (!s->hdr->auto_evict || !evict_one(s)) return SHM_ERR_FULL;
     off = alloc(s, size);
   }
   Entry* e = find_slot_for_insert(s, id);
@@ -565,6 +570,33 @@ uint64_t shm_store_bytes_in_use(void* handle) {
 
 uint64_t shm_store_num_objects(void* handle) {
   return static_cast<Store*>(handle)->hdr->num_objects;
+}
+
+// Toggle silent LRU eviction on create pressure. With it off, create
+// returns SHM_ERR_FULL and the client spills the LRU candidate first.
+void shm_store_set_auto_evict(void* handle, int enabled) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  s->hdr->auto_evict = enabled ? 1 : 0;
+}
+
+// Id of the current LRU unpinned sealed object (the next eviction victim),
+// without evicting it. SHM_ERR_NOT_FOUND when nothing is evictable.
+int shm_store_lru_candidate(void* handle, uint8_t* out_id) {
+  Store* s = static_cast<Store*>(handle);
+  Locker lock(s);
+  Entry* t = table(s);
+  uint64_t slots = s->hdr->table_slots;
+  Entry* victim = nullptr;
+  for (uint64_t i = 0; i < slots; i++) {
+    Entry* e = &t[i];
+    if (e->state == kSealed && e->pins == 0) {
+      if (!victim || e->lru_tick < victim->lru_tick) victim = e;
+    }
+  }
+  if (!victim) return SHM_ERR_NOT_FOUND;
+  memcpy(out_id, victim->id, kIdSize);
+  return SHM_OK;
 }
 
 // Base pointer of the mapping (Python builds a memoryview over it).

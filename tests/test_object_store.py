@@ -129,3 +129,33 @@ def test_read_pin_protects_live_array(store):
         store.put_raw(_oid(2000 + i), [b"z" * (1024 * 1024)])
     assert store.contains(oid)
     np.testing.assert_array_equal(out, arr)
+
+
+def test_overflow_spilling_roundtrip(tmp_path):
+    """Objects that exceed the arena spill to disk and read back
+    transparently (reference: local_object_manager.h spilling)."""
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=64 * 1024 * 1024)
+    try:
+        # 5 x 30MB > 64MB arena: later puts must spill, all must resolve.
+        arrays = [np.full(30 * 1024 * 1024, i, dtype=np.uint8)
+                  for i in range(5)]
+        refs = [ray_tpu.put(a) for a in arrays]
+        for i, r in enumerate(refs):
+            out = ray_tpu.get(r, timeout=60)
+            assert out[0] == i and len(out) == 30 * 1024 * 1024
+        # Task results overflow too.
+        @ray_tpu.remote
+        def big(i):
+            import numpy as np
+
+            return np.full(30 * 1024 * 1024, 100 + i, dtype=np.uint8)
+
+        refs2 = [big.remote(i) for i in range(3)]
+        for i, r in enumerate(refs2):
+            assert ray_tpu.get(r, timeout=120)[0] == 100 + i
+    finally:
+        ray_tpu.shutdown()

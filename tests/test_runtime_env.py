@@ -1,0 +1,70 @@
+"""Runtime environment tests (reference: python/ray/tests/test_runtime_env*
+— env_vars, working_dir, py_modules shipping)."""
+
+import os
+import textwrap
+
+import ray_tpu
+
+
+def test_env_vars_passthrough(ray_start_regular):
+    @ray_tpu.remote
+    def read_env():
+        return os.environ.get("MY_FLAG")
+
+    out = ray_tpu.get(read_env.options(
+        runtime_env={"env_vars": {"MY_FLAG": "42"}}).remote(), timeout=60)
+    assert out == "42"
+
+
+def test_working_dir_ships_files(ray_start_regular, tmp_path):
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "config.txt").write_text("hello-from-working-dir")
+    (wd / "helper.py").write_text("VALUE = 123\n")
+
+    @ray_tpu.remote
+    def read_all():
+        import helper  # importable: working_dir is on PYTHONPATH
+
+        with open("config.txt") as f:  # cwd == working_dir
+            return f.read(), helper.VALUE
+
+    text, val = ray_tpu.get(read_all.options(
+        runtime_env={"working_dir": str(wd)}).remote(), timeout=120)
+    assert text == "hello-from-working-dir"
+    assert val == 123
+
+
+def test_py_modules_importable(ray_start_regular, tmp_path):
+    mod = tmp_path / "mylib"
+    mod.mkdir()
+    (mod / "__init__.py").write_text(textwrap.dedent("""
+        def shout(x):
+            return x.upper()
+    """))
+
+    @ray_tpu.remote
+    def use_lib():
+        import mylib
+
+        return mylib.shout("tpu")
+
+    out = ray_tpu.get(use_lib.options(
+        runtime_env={"py_modules": [str(mod)]}).remote(), timeout=120)
+    assert out == "TPU"
+
+
+def test_actor_runtime_env(ray_start_regular, tmp_path):
+    wd = tmp_path / "actorproj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("actor-data")
+
+    @ray_tpu.remote
+    class Reader:
+        def read(self):
+            with open("data.txt") as f:
+                return f.read()
+
+    a = Reader.options(runtime_env={"working_dir": str(wd)}).remote()
+    assert ray_tpu.get(a.read.remote(), timeout=120) == "actor-data"
