@@ -695,6 +695,8 @@ class GcsServer:
                     continue
                 try:
                     if await self._schedule_pg(info):
+                        if info.state == "REMOVED":
+                            continue
                         info.state = "CREATED"
                         await self.pubsub.publish(
                             "placement_groups",
@@ -758,6 +760,18 @@ class GcsServer:
                     pass
             return False
         info.bundle_nodes = assignment
+        if self.placement_groups.get(info.pg_id) is not info:
+            # Removed while we were preparing/committing (the retry loop
+            # races rpc_remove_placement_group): give the bundles back
+            # immediately or they leak on the nodelets forever.
+            for i, nid in assignment.items():
+                try:
+                    await self._nodelet(nid).call(
+                        "return_bundle", pg_id=info.pg_id.binary(),
+                        bundle_index=i)
+                except Exception:
+                    pass
+            return False
         return True
 
     async def rpc_remove_placement_group(self, pg_id: bytes) -> Dict[str, Any]:
@@ -765,6 +779,7 @@ class GcsServer:
         info = self.placement_groups.pop(pgid, None)
         if info is None:
             return {"ok": False}
+        info.state = "REMOVED"  # in-flight retry scheduling must not revive it
         for i, nid in info.bundle_nodes.items():
             try:
                 await self._nodelet(nid).call(

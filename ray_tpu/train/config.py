@@ -24,6 +24,16 @@ class ScalingConfig:
     resources_per_worker: Optional[Dict[str, float]] = None
     placement_strategy: str = "PACK"
     topology: str = ""
+    # Elastic bounds (reference: train/v2 scaling_policy — elastic worker
+    # groups). When min_workers is set, each (re)start sizes the group to
+    # what the cluster can currently schedule, clamped to
+    # [min_workers, num_workers]; a shrunken cluster no longer blocks
+    # training (TPU preemption recovery path).
+    min_workers: Optional[int] = None
+
+    @property
+    def elastic(self) -> bool:
+        return self.min_workers is not None
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})
@@ -31,6 +41,17 @@ class ScalingConfig:
         if self.use_tpu or self.tpus_per_worker:
             res["TPU"] = float(self.tpus_per_worker or 1.0)
         return res
+
+    def resolve_num_workers(self, available: Dict[str, float]) -> int:
+        """Elastic sizing against the cluster's current availability."""
+        if not self.elastic:
+            return self.num_workers
+        per = self.worker_resources()
+        fit = self.num_workers
+        for k, v in per.items():
+            if v > 0:
+                fit = min(fit, int(available.get(k, 0) // v))
+        return max(self.min_workers or 1, min(self.num_workers, fit))
 
 
 @dataclasses.dataclass

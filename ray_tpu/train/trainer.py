@@ -82,11 +82,19 @@ class DataParallelTrainer:
         restore: Optional[Checkpoint] = None
 
         while True:
-            group = self._start_group(restore)
+            group = None
             try:
+                group = self._start_group(restore)
                 error = self._poll_until_done(group, manager, history)
+            except (RayActorError, ray_tpu.ActorDiedError,
+                    ray_tpu.ActorUnavailableError, ray_tpu.GetTimeoutError,
+                    RuntimeError) as e:
+                # Failures during group startup (e.g. a node died between
+                # placement and setup) retry the same way poll failures do.
+                error = f"group start failed: {e}"
             finally:
-                group.shutdown()
+                if group is not None:
+                    group.shutdown()
             if error is None:
                 return Result(
                     metrics=history[-1] if history else None,
@@ -107,33 +115,50 @@ class DataParallelTrainer:
     # ------------------------------------------------------------------
     def _start_group(self, restore: Optional[Checkpoint]) -> WorkerGroup:
         name = self.run_config.name or self.train_fn.__name__
+        num_workers = self.scaling.num_workers
+        if self.scaling.elastic:
+            num_workers = self.scaling.resolve_num_workers(
+                ray_tpu.available_resources())
+            logger.info("elastic scaling: starting group at world size %d "
+                        "(target %d)", num_workers, self.scaling.num_workers)
         group = WorkerGroup(
-            num_workers=self.scaling.num_workers,
+            num_workers=num_workers,
             resources_per_worker=self.scaling.worker_resources(),
             placement_strategy=self.scaling.placement_strategy,
             experiment_name=name,
+            # Elastic groups fail placement fast: a stale resource view
+            # right after a node death would otherwise block the whole
+            # placement timeout before the next (smaller) attempt.
+            pg_timeout=20.0 if self.scaling.elastic else 120.0,
         )
-        backend_config: Dict[str, Any] = {"kind": self.backend}
-        if self.backend == "jax" and self.scaling.num_workers > 1:
-            from ray_tpu._private.node import free_port
+        try:
+            backend_config: Dict[str, Any] = {"kind": self.backend}
+            if self.backend == "jax" and num_workers > 1:
+                from ray_tpu._private.node import free_port
 
-            ip = ray_tpu.get(group.workers[0].node_ip.remote(), timeout=30)
-            backend_config["coordinator"] = f"{ip}:{free_port()}"
-        group.setup_backend(backend_config)
-        shards = self._dataset_shards()
-        # Fresh staging area per attempt: undrained staged checkpoints from a
-        # failed attempt would otherwise accumulate forever.
-        staging = os.path.join(self.run_config.resolved_storage_path(),
-                               ".staging")
-        shutil.rmtree(staging, ignore_errors=True)
-        group.start_training(self.train_fn, self.config, restore, shards,
-                             staging_dir=staging)
-        return group
+                ip = ray_tpu.get(group.workers[0].node_ip.remote(),
+                                 timeout=30)
+                backend_config["coordinator"] = f"{ip}:{free_port()}"
+            group.setup_backend(backend_config)
+            shards = self._dataset_shards(num_workers)
+            # Fresh staging area per attempt: undrained staged checkpoints
+            # from a failed attempt would otherwise accumulate forever.
+            staging = os.path.join(self.run_config.resolved_storage_path(),
+                                   ".staging")
+            shutil.rmtree(staging, ignore_errors=True)
+            group.start_training(self.train_fn, self.config, restore, shards,
+                                 staging_dir=staging)
+            return group
+        except BaseException:
+            # A half-started group must release its placement group and
+            # actors, or its bundles leak cluster resources.
+            group.shutdown()
+            raise
 
-    def _dataset_shards(self):
+    def _dataset_shards(self, num_workers: Optional[int] = None):
         if not self.datasets:
             return None
-        n = self.scaling.num_workers
+        n = num_workers or self.scaling.num_workers
         per_worker: List[Dict[str, Any]] = [{} for _ in range(n)]
         for name, ds in self.datasets.items():
             if hasattr(ds, "streaming_split"):

@@ -41,7 +41,11 @@ class _TrainWorker:
         self._thread: Optional[threading.Thread] = None
 
     def node_ip(self) -> str:
-        return "127.0.0.1"
+        # The nodelet's bind host is this node's reachable address — using
+        # it (not loopback) lets the jax.distributed coordinator bind an
+        # address other hosts can dial in multi-host clusters.
+        addr = os.environ.get("RAY_TPU_NODELET_ADDR", "127.0.0.1:0")
+        return addr.rsplit(":", 1)[0]
 
     def node_id(self) -> str:
         return os.environ.get("RAY_TPU_NODE_ID", "")
@@ -122,12 +126,13 @@ class WorkerGroup:
 
     def __init__(self, num_workers: int, resources_per_worker: Dict[str, float],
                  placement_strategy: str, experiment_name: str,
-                 env_vars: Optional[Dict[str, str]] = None):
+                 env_vars: Optional[Dict[str, str]] = None,
+                 pg_timeout: float = 120.0):
         self.num_workers = num_workers
         self.experiment_name = experiment_name
         bundles = [dict(resources_per_worker) for _ in range(num_workers)]
         self.pg = placement_group(bundles, strategy=placement_strategy)
-        if not self.pg.ready(timeout=120):
+        if not self.pg.ready(timeout=pg_timeout):
             remove_placement_group(self.pg)
             raise RuntimeError(
                 f"placement group for {num_workers} x {resources_per_worker} "

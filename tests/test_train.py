@@ -166,3 +166,4 @@ def test_dp_training_with_collective_sync(ray_start_regular):
         backend="none",
     ).fit()
     assert result.metrics["loss"] < 0.01
+

@@ -1,0 +1,73 @@
+"""Elastic training tests (own module: they build private clusters and must
+not share the module-scoped cluster fixture)."""
+
+import ray_tpu
+
+
+def test_elastic_restart_shrinks_world_size(tmp_path):
+    """Elastic scaling: after losing a node, the restarted group runs at a
+    smaller world size instead of blocking (reference: train/v2
+    scaling_policy elastic + failure policy)."""
+    import time as _time
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.train import (
+        DataParallelTrainer,
+        FailureConfig,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    c = Cluster(head_node_args={"num_cpus": 2, "node_name": "head",
+                                "object_store_memory": 128 * 1024 * 1024})
+    n2 = c.add_node(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+    try:
+        c.connect()
+
+        def train_loop(config):
+            import time
+
+            from ray_tpu import train as rt
+
+            ctx = rt.get_context()
+            # First attempt: report, then rank 1+ workers die with the node.
+            rt.report({"world_size": ctx.get_world_size()})
+            time.sleep(3.0)
+            rt.report({"world_size": ctx.get_world_size(), "done": 1})
+
+        trainer = DataParallelTrainer(
+            train_loop,
+            scaling_config=ScalingConfig(num_workers=4, min_workers=1,
+                                         cpus_per_worker=1.0,
+                                         placement_strategy="SPREAD"),
+            run_config=RunConfig(storage_path=str(tmp_path),
+                                 failure_config=FailureConfig(max_failures=2)),
+        )
+
+        import threading
+
+        result_box = {}
+
+        def run():
+            try:
+                result_box["result"] = trainer.fit()
+            except BaseException as e:  # surfaced in the main thread
+                result_box["error"] = e
+
+        t = threading.Thread(target=run)
+        t.start()
+        _time.sleep(2.0)  # group is up and mid-sleep
+        c.remove_node(n2)  # kill half the cluster
+        t.join(timeout=180)
+        assert not t.is_alive(), "trainer did not finish after node loss"
+        assert "error" not in result_box, result_box.get("error")
+        result = result_box["result"]
+        # Training completed at a SHRUNKEN world size after losing half the
+        # cluster (exact sizes are timing-dependent: rank-0 reports from the
+        # killed attempt may be lost, and the first restart may still see a
+        # stale resource view).
+        assert result.metrics.get("done") == 1
+        assert result.metrics["world_size"] < 4
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
