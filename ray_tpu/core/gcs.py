@@ -694,9 +694,9 @@ class GcsServer:
                 if info.state != "PENDING":
                     continue
                 try:
+                    # _schedule_pg itself handles the removed-while-
+                    # scheduling race (membership check + bundle return).
                     if await self._schedule_pg(info):
-                        if info.state == "REMOVED":
-                            continue
                         info.state = "CREATED"
                         await self.pubsub.publish(
                             "placement_groups",

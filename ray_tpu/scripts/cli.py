@@ -1,0 +1,54 @@
+"""CLI (reference: python/ray/scripts/scripts.py — `ray status/list/...`).
+
+Usage: python -m ray_tpu.scripts.cli --address HOST:PORT <command>
+Commands: status | nodes | actors | workers | jobs | placement-groups
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    parser.add_argument("--address", required=True,
+                        help="GCS address host:port of a running cluster")
+    parser.add_argument("command", choices=[
+        "status", "nodes", "actors", "workers", "jobs", "placement-groups"])
+    args = parser.parse_args()
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    ray_tpu.init(address=args.address)
+    try:
+        if args.command == "status":
+            out = state.cluster_summary()
+        elif args.command == "nodes":
+            out = state.list_nodes()
+        elif args.command == "actors":
+            out = state.list_actors()
+        elif args.command == "workers":
+            out = state.list_workers()
+        elif args.command == "jobs":
+            out = state.list_jobs()
+        else:
+            out = state.list_placement_groups()
+        json.dump(out, sys.stdout, indent=2, default=_jsonable)
+        print()
+    finally:
+        ray_tpu.shutdown()
+
+
+def _jsonable(o):
+    if isinstance(o, bytes):
+        return o.hex()
+    if isinstance(o, tuple):
+        return list(o)
+    return str(o)
+
+
+if __name__ == "__main__":
+    main()

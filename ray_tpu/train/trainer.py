@@ -84,14 +84,17 @@ class DataParallelTrainer:
         while True:
             group = None
             try:
-                group = self._start_group(restore)
-                error = self._poll_until_done(group, manager, history)
-            except (RayActorError, ray_tpu.ActorDiedError,
-                    ray_tpu.ActorUnavailableError, ray_tpu.GetTimeoutError,
-                    RuntimeError) as e:
-                # Failures during group startup (e.g. a node died between
-                # placement and setup) retry the same way poll failures do.
-                error = f"group start failed: {e}"
+                try:
+                    group = self._start_group(restore)
+                except (RayActorError, ray_tpu.ActorDiedError,
+                        ray_tpu.ActorUnavailableError,
+                        ray_tpu.GetTimeoutError, RuntimeError) as e:
+                    # Failures during group startup (e.g. a node died
+                    # between placement and setup) retry the same way poll
+                    # failures do; poll-phase errors keep their own handling.
+                    error = f"group start failed: {e}"
+                else:
+                    error = self._poll_until_done(group, manager, history)
             finally:
                 if group is not None:
                     group.shutdown()
@@ -134,11 +137,13 @@ class DataParallelTrainer:
         try:
             backend_config: Dict[str, Any] = {"kind": self.backend}
             if self.backend == "jax" and num_workers > 1:
-                from ray_tpu._private.node import free_port
-
-                ip = ray_tpu.get(group.workers[0].node_ip.remote(),
-                                 timeout=30)
-                backend_config["coordinator"] = f"{ip}:{free_port()}"
+                # The coordinator binds on worker 0's HOST — pick the free
+                # port there, not on the driver (different machines in
+                # multi-host clusters).
+                ip, port = ray_tpu.get(
+                    group.workers[0].coordinator_endpoint.remote(),
+                    timeout=30)
+                backend_config["coordinator"] = f"{ip}:{port}"
             group.setup_backend(backend_config)
             shards = self._dataset_shards(num_workers)
             # Fresh staging area per attempt: undrained staged checkpoints

@@ -47,6 +47,13 @@ class _TrainWorker:
         addr = os.environ.get("RAY_TPU_NODELET_ADDR", "127.0.0.1:0")
         return addr.rsplit(":", 1)[0]
 
+    def coordinator_endpoint(self):
+        """(ip, free_port) picked ON THIS HOST — where the jax.distributed
+        coordinator (rank 0) will actually bind."""
+        from ray_tpu._private.node import free_port
+
+        return (self.node_ip(), free_port())
+
     def node_id(self) -> str:
         return os.environ.get("RAY_TPU_NODE_ID", "")
 
@@ -139,16 +146,23 @@ class WorkerGroup:
                 "could not be scheduled")
         WorkerActor = ray_tpu.remote(_TrainWorker)
         self.workers = []
-        for rank in range(num_workers):
-            self.workers.append(
-                WorkerActor.options(
-                    num_cpus=resources_per_worker.get("CPU", 1.0),
-                    num_tpus=resources_per_worker.get("TPU", 0.0) or None,
-                    scheduling_strategy=PlacementGroupSchedulingStrategy(
-                        placement_group=self.pg,
-                        placement_group_bundle_index=rank),
-                ).remote(rank, num_workers, local_rank=0, node_rank=rank,
-                         experiment_name=experiment_name, env_vars=env_vars))
+        try:
+            for rank in range(num_workers):
+                self.workers.append(
+                    WorkerActor.options(
+                        num_cpus=resources_per_worker.get("CPU", 1.0),
+                        num_tpus=resources_per_worker.get("TPU", 0.0) or None,
+                        scheduling_strategy=PlacementGroupSchedulingStrategy(
+                            placement_group=self.pg,
+                            placement_group_bundle_index=rank),
+                    ).remote(rank, num_workers, local_rank=0, node_rank=rank,
+                             experiment_name=experiment_name,
+                             env_vars=env_vars))
+        except BaseException:
+            # A failure mid-creation must not strand the committed
+            # placement group (its bundles would leak cluster resources).
+            self.shutdown()
+            raise
 
     def setup_backend(self, backend_config: Dict[str, Any]) -> None:
         ray_tpu.get([w.setup_backend.remote(backend_config)

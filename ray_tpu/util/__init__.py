@@ -1,17 +1,21 @@
 """User-facing utilities (reference: python/ray/util/)."""
 
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import (
     PlacementGroup,
     placement_group,
     remove_placement_group,
 )
+from ray_tpu.util.queue import Queue
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
 
 __all__ = [
+    "ActorPool",
     "NodeAffinitySchedulingStrategy",
+    "Queue",
     "PlacementGroup",
     "PlacementGroupSchedulingStrategy",
     "placement_group",

@@ -1,0 +1,73 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py) — round-robin work
+distribution over a fixed set of actors with ordered/unordered result
+iteration."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._pending: List[Any] = []  # submission order
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        if not self._idle:
+            # Wait for any in-flight call to finish, then reuse its actor.
+            ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                    num_returns=1, timeout=None)
+            self._reclaim(ready[0])
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = actor
+        self._pending.append(ref)
+
+    def _reclaim(self, ref) -> None:
+        actor = self._future_to_actor.pop(ref, None)
+        if actor is not None:
+            self._idle.append(actor)
+
+    def get_next(self, timeout=None) -> Any:
+        """Next result in submission order. On timeout the item stays
+        pending (a retry returns the same item, nothing is skipped)."""
+        if not self._pending:
+            raise StopIteration("no pending results")
+        ref = self._pending[0]
+        out = ray_tpu.get(ref, timeout=timeout)  # raises -> ref not consumed
+        self._pending.pop(0)
+        self._reclaim(ref)
+        return out
+
+    def get_next_unordered(self, timeout=None) -> Any:
+        if not self._pending:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(self._pending, num_returns=1,
+                                timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result ready")
+        ref = ready[0]
+        self._pending.remove(ref)
+        out = ray_tpu.get(ref)
+        self._reclaim(ref)
+        return out
+
+    def has_next(self) -> bool:
+        return bool(self._pending)
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterable[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterable[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

@@ -1,0 +1,80 @@
+"""Distributed FIFO queue backed by an actor (reference:
+python/ray/util/queue.py)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import collections
+
+        self._maxsize = maxsize
+        self._items: "collections.deque" = collections.deque()
+
+    def put(self, item: Any) -> bool:
+        if self._maxsize > 0 and len(self._items) >= self._maxsize:
+            return False
+        self._items.append(item)
+        return True
+
+    def get(self) -> tuple:
+        if not self._items:
+            return (False, None)
+        return (True, self._items.popleft())
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0, *, actor_options: Optional[dict] = None):
+        Actor = ray_tpu.remote(_QueueActor)
+        opts = dict(actor_options or {})
+        opts.setdefault("num_cpus", 0.5)
+        self._actor = Actor.options(**opts).remote(maxsize)
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self._actor.put.remote(item), timeout=30):
+                return
+            if not block or (deadline and time.monotonic() > deadline):
+                raise Full("queue full")
+            time.sleep(0.02)
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self._actor.get.remote(), timeout=30)
+            if ok:
+                return item
+            if not block or (deadline and time.monotonic() > deadline):
+                raise Empty("queue empty")
+            time.sleep(0.02)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote(), timeout=30)
+
+    def empty(self) -> bool:
+        return self.qsize() == 0

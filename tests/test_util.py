@@ -1,0 +1,58 @@
+"""util components: ActorPool, Queue, CLI (reference: ray.util)."""
+
+import json
+import subprocess
+import sys
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Queue
+
+
+def test_actor_pool_ordered_and_unordered(ray_start_regular):
+    @ray_tpu.remote
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(2)])
+    out = list(pool.map(lambda a, v: a.sq.remote(v), range(8)))
+    assert out == [i * i for i in range(8)]
+    out2 = sorted(pool.map_unordered(lambda a, v: a.sq.remote(v), range(8)))
+    assert out2 == sorted(i * i for i in range(8))
+
+
+def test_distributed_queue(ray_start_regular):
+    q = Queue(maxsize=4)
+    for i in range(4):
+        q.put(i)
+    assert q.qsize() == 4
+
+    @ray_tpu.remote
+    def consume(q, n):
+        return [q.get(timeout=30) for _ in range(n)]
+
+    got = ray_tpu.get(consume.remote(q, 4), timeout=60)
+    assert got == [0, 1, 2, 3]
+    assert q.empty()
+    try:
+        q.get_nowait()
+        assert False, "expected Empty"
+    except Empty:
+        pass
+
+
+def test_cli_status(ray_start_regular):
+    import os
+
+    from ray_tpu._private import worker as wm
+
+    addr = "%s:%d" % wm.global_worker().gcs_address
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "--address", addr,
+         "status"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-500:]
+    summary = json.loads(out.stdout)
+    assert summary["nodes_alive"] >= 1
