@@ -555,6 +555,10 @@ class Worker:
         self._actor_states: Dict[str, Dict[str, Any]] = {}
         self._actor_pulse = asyncio.Event()
         self._actor_sub_started = False
+        # Task-event buffer (timeline/profiling floor).
+        self._task_events: List[Dict[str, Any]] = []
+        self._task_events_lock = threading.Lock()
+        self._task_events_flusher_started = False
         # Executor side: cached clients for streaming items back to owners.
         self._gen_clients: Dict[Tuple[str, int], RpcClient] = {}
         self.connected = False
@@ -657,6 +661,42 @@ class Worker:
     # ------------------------------------------------------------------
     # Owned-object lifecycle
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # Task events / timeline (reference: task_event_buffer.h ->
+    # GcsTaskManager -> `ray timeline` chrome trace)
+    # ------------------------------------------------------------------
+    def record_task_event(self, spec: TaskSpec, start_ts: float,
+                          end_ts: float, ok: bool) -> None:
+        with self._task_events_lock:
+            self._task_events.append({
+                "task_id": spec.task_id.hex(),
+                "name": spec.function_name,
+                "type": spec.task_type.name,
+                "pid": os.getpid(),
+                "node_id": self.node_id.hex(),
+                "start_ts": start_ts,
+                "end_ts": end_ts,
+                "ok": ok,
+            })
+            if not self._task_events_flusher_started:
+                self._task_events_flusher_started = True
+                self.loop.call_soon_threadsafe(
+                    lambda: asyncio.ensure_future(self._task_event_loop()))
+
+    async def _task_event_loop(self) -> None:
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            with self._task_events_lock:
+                events, self._task_events = self._task_events, []
+            if not events:
+                continue
+            try:
+                await self.gcs_client.call("report_task_events",
+                                           events=events)
+            except Exception:
+                with self._task_events_lock:
+                    self._task_events = events + self._task_events
+
     @property
     def spill_dir(self) -> str:
         return os.path.join(self.session_dir, "spill", self.node_id.hex())
@@ -1626,6 +1666,8 @@ class Worker:
             executor, self._execute_actor_task_sync, task_spec, method)
 
     def _execute_actor_task_sync(self, spec: TaskSpec, method: Any) -> Dict[str, Any]:
+        t0 = time.time()
+        ok = True
         try:
             args, kwargs = self._resolve_spec_args_sync(spec)
             self._current_task_id = spec.task_id
@@ -1635,14 +1677,18 @@ class Worker:
             return self._with_borrows(spec, {
                 "results": self._pack_results(spec, result)})
         except BaseException as e:  # noqa: BLE001
+            ok = False
             return {"results": [self._error_result(e)] * max(1, spec.num_returns)}
         finally:
             self._current_task_id = None
+            self.record_task_event(spec, t0, time.time(), ok)
 
     def _execute_task_sync(self, spec: TaskSpec) -> Dict[str, Any]:
         if spec.task_id in self._cancelled_tasks:
             self._cancelled_tasks.discard(spec.task_id)
             return {"cancelled": True, "results": []}
+        t0 = time.time()
+        ok = True
         try:
             fn = self.function_manager.fetch(spec.function_key)
             args, kwargs = self._resolve_spec_args_sync(spec)
@@ -1653,10 +1699,12 @@ class Worker:
             return self._with_borrows(spec, {
                 "results": self._pack_results(spec, result)})
         except BaseException as e:  # noqa: BLE001
+            ok = False
             logger.info("task %s raised: %r", spec.function_name, e)
             return {"results": [self._error_result(e)] * max(1, spec.num_returns)}
         finally:
             self._current_task_id = None
+            self.record_task_event(spec, t0, time.time(), ok)
 
     def _spec_arg_ref_ids(self, spec: TaskSpec) -> List[ObjectID]:
         """ObjectIDs referenced by this task's args (direct ref args and

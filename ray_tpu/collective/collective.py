@@ -147,7 +147,18 @@ def init_collective_group(
     """Join (creating if needed) a named collective group. Every participant
     calls this with its rank; rendezvous is via a named detached actor
     (reference: nccl rendezvous via named actor, nccl_collective_group.py:29)."""
-    if backend not in ("store", "jax"):
+    if backend == "jax":
+        # Eager cross-host collectives are the anti-pattern on TPU: the
+        # idiomatic path is collectives compiled INTO jitted programs over a
+        # mesh (ray_tpu.parallel + train/step.py), with jax.distributed
+        # providing the multi-host runtime (train backend "jax"). Refusing
+        # loudly beats silently falling back to the store backend.
+        raise NotImplementedError(
+            'backend="jax" is not an eager collective backend: use '
+            "ray_tpu.parallel (shard_map/pjit collectives over ICI) or the "
+            'Train "jax" backend for multi-host meshes; backend="store" is '
+            "the CPU control-plane collective")
+    if backend != "store":
         raise ValueError(f"unknown backend {backend!r}")
     actor_name = f"__collective_{group_name}"
     Coord = ray_tpu.remote(_Coordinator)

@@ -222,6 +222,9 @@ class GcsServer:
         self._background: List[asyncio.Task] = []
         self._actor_locks: Dict[ActorID, asyncio.Lock] = {}
         self._spread_rr = 0
+        from collections import deque
+
+        self.task_events: "deque" = deque(maxlen=20_000)
         self.storage = GcsStorage(persist_path)
         self._restore()
 
@@ -807,6 +810,18 @@ class GcsServer:
     # ------------------------------------------------------------------
     # Pub/sub RPC surface
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # Task events (reference: gcs_task_manager.h:94 — bounded aggregation
+    # feeding the state API and `timeline`)
+    # ------------------------------------------------------------------
+    async def rpc_report_task_events(
+            self, events: List[Dict[str, Any]]) -> None:
+        self.task_events.extend(events)
+
+    async def rpc_list_task_events(
+            self, limit: int = 1000) -> List[Dict[str, Any]]:
+        return list(self.task_events)[-limit:]
+
     async def rpc_pubsub_poll(
         self, cursors: Dict[str, int], timeout: float = 30.0
     ) -> Dict[str, List[Tuple[int, Any]]]:

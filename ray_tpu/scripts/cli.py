@@ -16,7 +16,8 @@ def main() -> None:
     parser.add_argument("--address", required=True,
                         help="GCS address host:port of a running cluster")
     parser.add_argument("command", choices=[
-        "status", "nodes", "actors", "workers", "jobs", "placement-groups"])
+        "status", "nodes", "actors", "workers", "jobs", "placement-groups",
+        "tasks", "timeline"])
     args = parser.parse_args()
 
     import ray_tpu
@@ -34,6 +35,10 @@ def main() -> None:
             out = state.list_workers()
         elif args.command == "jobs":
             out = state.list_jobs()
+        elif args.command == "tasks":
+            out = state.list_tasks()
+        elif args.command == "timeline":
+            out = {"written": state.timeline("timeline.json")}
         else:
             out = state.list_placement_groups()
         json.dump(out, sys.stdout, indent=2, default=_jsonable)

@@ -71,6 +71,36 @@ def list_workers() -> List[Dict[str, Any]]:
     return w.loop_thread.run(_collect())
 
 
+def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Recently finished task executions (reference: `ray list tasks`,
+    backed by GcsTaskManager events)."""
+    return _gcs("list_task_events", limit=limit)
+
+
+def timeline(path: Optional[str] = None) -> Any:
+    """chrome://tracing dump of recorded task events (reference:
+    `ray timeline`, scripts.py:2689)."""
+    import json
+
+    events = []
+    for ev in list_tasks(limit=20_000):
+        events.append({
+            "name": ev["name"],
+            "cat": ev.get("type", "TASK"),
+            "ph": "X",
+            "ts": ev["start_ts"] * 1e6,
+            "dur": max(0.0, (ev["end_ts"] - ev["start_ts"]) * 1e6),
+            "pid": ev.get("node_id", "")[:8],
+            "tid": ev.get("pid", 0),
+            "args": {"task_id": ev["task_id"], "ok": ev.get("ok", True)},
+        })
+    if path is None:
+        return events
+    with open(path, "w") as f:
+        json.dump(events, f)
+    return path
+
+
 def summarize_actors() -> Dict[str, int]:
     counts: Dict[str, int] = {}
     for a in list_actors():

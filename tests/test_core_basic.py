@@ -148,3 +148,34 @@ def test_state_api(ray_start_regular):
     assert summary["nodes_alive"] >= 1
     assert summary["actors"].get("ALIVE", 0) >= 1
     assert summary["resources_total"].get("CPU", 0) >= 8
+
+
+def test_task_events_and_timeline(ray_start_regular, tmp_path):
+    """Task timeline floor (reference: task_event_buffer -> GcsTaskManager
+    -> `ray timeline` chrome trace)."""
+    import time as _t
+
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def traced(x):
+        return x + 1
+
+    assert ray_tpu.get([traced.remote(i) for i in range(5)]) == list(
+        range(1, 6))
+    # Events flush to the GCS on a ~1s cadence.
+    deadline = _t.time() + 15
+    while _t.time() < deadline:
+        tasks = [t for t in state.list_tasks() if t["name"] == "traced"]
+        if len(tasks) >= 5:
+            break
+        _t.sleep(0.5)
+    assert len(tasks) >= 5
+    assert all(t["end_ts"] >= t["start_ts"] and t["ok"] for t in tasks)
+    out = str(tmp_path / "trace.json")
+    state.timeline(out)
+    import json
+
+    with open(out) as f:
+        trace = json.load(f)
+    assert any(ev["name"] == "traced" for ev in trace)
