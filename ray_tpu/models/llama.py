@@ -44,6 +44,10 @@ class LlamaConfig:
     # "flash" (pallas), "reference", or "ring" (sequence parallel)
     attention_impl: str = "flash"
     remat: bool = True
+    # >0 replaces the dense SwiGLU Mlp with a switch-routed MoE of this many
+    # experts (expert dim shards over the mesh "expert" axis — EP).
+    num_experts: int = 0
+    moe_capacity_factor: float = 1.25
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -73,6 +77,11 @@ LLAMA_SHARDING = ParamShardingRules([
     (r"o_proj/kernel", ("heads", "head_dim", "embed_fsdp")),
     (r"(gate_proj|up_proj)/kernel", ("embed_fsdp", "mlp")),
     (r"down_proj/kernel", ("mlp", "embed_fsdp")),
+    # MoE experts: the leading expert dim shards over the "expert" mesh
+    # axis (EP); within an expert the FFN shards like the dense Mlp.
+    (r"router/kernel", ("embed", None)),
+    (r"(gate_kernel|up_kernel)", ("expert", "embed_fsdp", "mlp")),
+    (r"down_kernel", ("expert", "mlp", "embed_fsdp")),
     (r"lm_head/kernel", ("embed_fsdp", "vocab")),
     (r"norm|input_layernorm|post_attention_layernorm", ("embed",)),
 ])
@@ -217,7 +226,16 @@ class DecoderLayer(nn.Module):
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x),
             positions, kv_cache, cache_index, paged)
         x = x + attn_out
-        x = x + Mlp(cfg, name="mlp")(
+        if cfg.num_experts > 0:
+            from ray_tpu.models.moe import MoEMlp
+
+            mlp = MoEMlp(cfg.hidden_size, cfg.intermediate_size,
+                         cfg.num_experts,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         dtype=cfg.dtype, name="mlp")
+        else:
+            mlp = Mlp(cfg, name="mlp")
+        x = x + mlp(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype,
                     name="post_attention_layernorm")(x))
         return x, new_cache
