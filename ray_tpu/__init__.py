@@ -28,6 +28,8 @@ from ray_tpu.exceptions import (
     ActorUnavailableError,
     GetTimeoutError,
     ObjectLostError,
+    ObjectStoreFullError,
+    WorkerCrashedError,
     RayActorError,
     RayTaskError,
     RayTpuError,
@@ -45,6 +47,8 @@ __all__ = [
     "ActorUnavailableError",
     "GetTimeoutError",
     "ObjectLostError",
+    "ObjectStoreFullError",
+    "WorkerCrashedError",
     "ObjectRef",
     "RayActorError",
     "RayTaskError",

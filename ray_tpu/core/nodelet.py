@@ -160,6 +160,8 @@ class Nodelet:
         )
         self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._background.append(asyncio.ensure_future(self._reap_loop()))
+        self._background.append(
+            asyncio.ensure_future(self._memory_monitor_loop()))
         logger.info("nodelet %s on %s:%d resources=%s", self.node_name, *addr,
                     self.resources_total)
         return addr
@@ -501,6 +503,53 @@ class Nodelet:
             except Exception as e:
                 logger.warning("heartbeat failed: %r", e)
             await asyncio.sleep(cfg.heartbeat_interval_s)
+
+    def _memory_usage(self) -> float:
+        cfg = get_config()
+        if cfg.testing_memory_usage >= 0:
+            return cfg.testing_memory_usage
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    info[k] = int(v.split()[0])
+            return 1.0 - info["MemAvailable"] / info["MemTotal"]
+        except Exception:
+            return 0.0
+
+    async def _memory_monitor_loop(self) -> None:
+        """OOM protection (reference: memory_monitor.h polling + the
+        retriable-LIFO worker killing policy, worker_killing_policy.h:69):
+        above the usage threshold, kill the most recently leased task
+        worker — its task retries elsewhere/later; actors are spared first
+        (their state is harder to recover)."""
+        cfg = get_config()
+        if cfg.memory_usage_threshold <= 0:
+            return
+        while not self._shutting_down:
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
+            usage = self._memory_usage()
+            if usage < cfg.memory_usage_threshold:
+                continue
+            leased = [w for w in self.workers.values()
+                      if w.leased and w.proc.poll() is None]
+            if not leased:
+                continue
+            tasks_first = sorted(
+                leased, key=lambda w: (w.lifetime != "task", -w.last_idle))
+            victim = tasks_first[0]
+            logger.warning(
+                "memory pressure %.0f%% >= %.0f%%: killing worker %s "
+                "(retriable-LIFO)", usage * 100,
+                cfg.memory_usage_threshold * 100,
+                victim.worker_id.hex()[:8])
+            try:
+                victim.proc.kill()
+            except Exception:
+                pass
+            # Let the reap loop handle resource return + death report.
+            await asyncio.sleep(1.0)
 
     async def _reap_loop(self) -> None:
         """Detect dead workers; release their resources; tell GCS (reference:

@@ -260,6 +260,26 @@ class Dataset:
 
         return Dataset([_Source(gen, name="RandomShuffle")])
 
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        """Global sort by a column (materializing — reference sort is a
+        distributed range shuffle; at this scale a gather sort wins)."""
+        full = block_concat(list(self.iter_blocks()))
+        order = np.argsort(np.asarray(full[key]), kind="stable")
+        if descending:
+            order = order[::-1]
+        data = {k: v[order] for k, v in full.items()}
+        n = block_num_rows(data)
+        per = max(1, min(DEFAULT_BLOCK_ROWS, n))
+
+        def gen(data=data, n=n, per=per):
+            for i in _range(0, n, per):
+                yield block_slice(data, i, min(i + per, n))
+
+        return Dataset([_Source(gen, name="Sort")])
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
     def split(self, n: int) -> List["Dataset"]:
         refs = list(self.iter_block_refs())
         out = []
@@ -313,6 +333,62 @@ class Dataset:
 
     def __repr__(self) -> str:
         return f"Dataset(plan={self.stats()})"
+
+
+class GroupedData:
+    """Groupby aggregations (reference: data/grouped_data.py — there a hash
+    shuffle over tasks; here a driver-side composition over the streamed
+    blocks, which is the right call at single-host block counts)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _gather(self):
+        full = block_concat(list(self._ds.iter_blocks()))
+        keys = np.asarray(full[self._key])
+        uniq, inv = np.unique(keys, return_inverse=True)
+        return full, uniq, inv
+
+    def _agg(self, fn, cols: Optional[Sequence[str]], suffix: str) -> Dataset:
+        full, uniq, inv = self._gather()
+        cols = [c for c in (cols or full.keys()) if c != self._key]
+        out: Dict[str, np.ndarray] = {self._key: uniq}
+        for c in cols:
+            vals = np.asarray(full[c])
+            out[f"{c}_{suffix}"] = np.asarray(
+                [fn(vals[inv == g]) for g in _range(len(uniq))])
+        return from_items(block_to_items(out))
+
+    def count(self) -> Dataset:
+        full, uniq, inv = self._gather()
+        counts = np.bincount(inv, minlength=len(uniq))
+        return from_items(block_to_items(
+            {self._key: uniq, "count": counts}))
+
+    def sum(self, cols: Optional[Sequence[str]] = None) -> Dataset:
+        return self._agg(np.sum, cols, "sum")
+
+    def mean(self, cols: Optional[Sequence[str]] = None) -> Dataset:
+        return self._agg(np.mean, cols, "mean")
+
+    def min(self, cols: Optional[Sequence[str]] = None) -> Dataset:
+        return self._agg(np.min, cols, "min")
+
+    def max(self, cols: Optional[Sequence[str]] = None) -> Dataset:
+        return self._agg(np.max, cols, "max")
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        full, uniq, inv = self._gather()
+        items: List[Any] = []
+        for g in _range(len(uniq)):
+            group = {k: v[inv == g] for k, v in full.items()}
+            res = fn(group)
+            if isinstance(res, list):
+                items.extend(res)
+            else:
+                items.append(res)
+        return from_items(items)
 
 
 def _remote_num_rows():

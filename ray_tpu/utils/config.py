@@ -73,9 +73,18 @@ class RayTpuConfig:
     retry_backoff_initial_s: float = 0.1
     retry_backoff_max_s: float = 10.0
 
+    # --- memory monitor / OOM (reference: memory_monitor.h + C19 worker
+    # killing policies) ---
+    # Node memory usage fraction above which the nodelet kills the most
+    # recently leased task worker (retriable-LIFO policy). <=0 disables.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_interval_s: float = 1.0
+
     # --- chaos / testing (reference: rpc_chaos.h, asio_chaos.cc) ---
     # "method:failure_prob" comma list, e.g. "push_task:0.1,lease:0.05".
     testing_rpc_failure: str = ""
+    # Force the memory monitor's usage reading (tests).
+    testing_memory_usage: float = -1.0
 
     # --- TPU ---
     # Virtualize TPU count for tests (like TPU_VISIBLE_CHIPS).

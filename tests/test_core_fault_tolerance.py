@@ -131,3 +131,43 @@ def test_rpc_chaos_injection_absorbed_by_retries():
     out = subprocess.run([sys.executable, "-c", CHAOS_SCRIPT], env=env,
                          capture_output=True, text=True, timeout=420)
     assert "CHAOS_OK" in out.stdout, out.stdout[-800:] + out.stderr[-2000:]
+
+
+OOM_SCRIPT = """
+import os
+os.environ["RAY_TPU_TESTING_MEMORY_USAGE"] = "0.99"
+os.environ["RAY_TPU_MEMORY_USAGE_THRESHOLD"] = "0.97"
+import time
+import ray_tpu
+
+ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024 * 1024)
+
+@ray_tpu.remote
+def hold():
+    import time
+    time.sleep(60)
+    return "survived"
+
+# The memory monitor must kill the leased task worker; with retries
+# exhausted, the task surfaces WorkerCrashedError.
+ref = hold.options(max_retries=0).remote()
+try:
+    ray_tpu.get(ref, timeout=60)
+    print("NO_KILL")
+except ray_tpu.WorkerCrashedError:
+    print("OOM_KILLED", flush=True)
+ray_tpu.shutdown()
+"""
+
+
+def test_memory_monitor_kills_leased_worker():
+    """OOM policy (reference: memory_monitor.h + retriable-LIFO killing):
+    under (simulated) memory pressure the nodelet kills the most recent
+    task worker."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", OOM_SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert "OOM_KILLED" in out.stdout, out.stdout[-500:] + out.stderr[-1500:]

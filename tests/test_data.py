@@ -136,3 +136,23 @@ def test_trainer_ingest_via_streaming_split(ray_start_regular, tmp_path):
     # History carries rank-0 metrics; the round-robin split gives each of
     # the 2 workers exactly half of the 8x50-row blocks.
     assert result.metrics["seen"] == 200
+
+
+def test_sort_and_groupby(ray_start_regular):
+    ds = rd.from_items([
+        {"k": i % 3, "v": float(i)} for i in range(30)
+    ])
+    top = ds.sort("v", descending=True).take(3)
+    assert [r["v"] for r in top] == [29.0, 28.0, 27.0]
+
+    counts = {int(r["k"]): int(r["count"])
+              for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+    means = {int(r["k"]): float(r["v_mean"])
+             for r in ds.groupby("k").mean(["v"]).take_all()}
+    assert means[0] == sum(range(0, 30, 3)) / 10
+
+    spans = ds.groupby("k").map_groups(
+        lambda g: {"k": int(g["k"][0]),
+                   "span": float(g["v"].max() - g["v"].min())})
+    assert all(r["span"] == 27.0 for r in spans.take_all())
