@@ -1,0 +1,123 @@
+"""Dashboard (reference: python/ray/dashboard — 35k LoC aiohttp UI; here the
+API layer that matters operationally: a JSON HTTP service over the state
+API, same endpoint shapes a UI would consume).
+
+Runs as an actor hosting a stdlib asyncio HTTP server (same pattern as the
+serve proxy). Endpoints:
+  /api/summary            cluster_summary()
+  /api/nodes              list_nodes()
+  /api/actors             list_actors()
+  /api/workers            list_workers()
+  /api/jobs               list_jobs()
+  /api/placement_groups   list_placement_groups()
+  /api/tasks              list_task_events
+  /healthz
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+DASHBOARD_ACTOR_NAME = "DASHBOARD"
+
+
+class DashboardActor:
+    def __init__(self, port: int = 0):
+        self._port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._on_conn, host="127.0.0.1", port=self._port)
+        self._port = self._server.sockets[0].getsockname()[1]
+        logger.info("dashboard listening on %d", self._port)
+        return self._port
+
+    async def _on_conn(self, reader: asyncio.StreamReader,
+                       writer: asyncio.StreamWriter) -> None:
+        try:
+            line = await reader.readline()
+            if not line:
+                return
+            try:
+                _, path, _ = line.decode().split(" ", 2)
+            except ValueError:
+                return
+            while True:
+                h = await reader.readline()
+                if h in (b"\r\n", b"", b"\n"):
+                    break
+            status, body = await self._route(path)
+            writer.write(
+                b"HTTP/1.1 " + str(status).encode() + b" X\r\n"
+                b"content-type: application/json\r\n"
+                b"content-length: " + str(len(body)).encode() +
+                b"\r\nconnection: close\r\n\r\n" + body)
+            await writer.drain()
+        except ConnectionError:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _route(self, path: str):
+        from ray_tpu.util import state
+
+        loop = asyncio.get_running_loop()
+        if path == "/healthz":
+            return 200, b'"ok"'
+        table = {
+            "/api/summary": state.cluster_summary,
+            "/api/nodes": state.list_nodes,
+            "/api/actors": state.list_actors,
+            "/api/workers": state.list_workers,
+            "/api/jobs": state.list_jobs,
+            "/api/placement_groups": state.list_placement_groups,
+            "/api/tasks": state.list_tasks,
+        }
+        fn = table.get(path.rstrip("/"))
+        if fn is None:
+            return 404, b'{"error": "no such endpoint"}'
+        try:
+            # State calls block on the worker loop thread — keep them off
+            # this event loop.
+            out = await loop.run_in_executor(None, fn)
+            return 200, json.dumps(out, default=_jsonable).encode()
+        except Exception as e:
+            logger.exception("dashboard route %s failed", path)
+            return 500, json.dumps({"error": str(e)}).encode()
+
+
+def _jsonable(o):
+    if isinstance(o, bytes):
+        return o.hex()
+    if isinstance(o, tuple):
+        return list(o)
+    return str(o)
+
+
+def start_dashboard(port: int = 0) -> int:
+    """Start (or find) the dashboard actor; returns its HTTP port."""
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_ACTOR_NAME)
+        return ray_tpu.get(actor.port_of.remote(), timeout=30)
+    except Exception:
+        pass
+    Actor = ray_tpu.remote(_NamedDashboard)
+    actor = Actor.options(name=DASHBOARD_ACTOR_NAME, max_concurrency=16,
+                          num_cpus=0.5, get_if_exists=True).remote(port)
+    return ray_tpu.get(actor.start.remote(), timeout=60)
+
+
+class _NamedDashboard(DashboardActor):
+    async def port_of(self) -> int:
+        return self._port

@@ -1,0 +1,169 @@
+"""Job submission (reference: dashboard/modules/job/job_manager.py:60 +
+python/ray/job_submission SDK — submit an entrypoint command, supervise it,
+expose status + logs).
+
+Redesign: a detached supervisor actor per job runs the entrypoint as a
+subprocess (env wired to the cluster address so `ray_tpu.init(address=...)`
+inside the job attaches), captures combined output, and records
+status/logs in the GCS KV. The client is a thin reader of that state."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+SUCCEEDED = "SUCCEEDED"
+FAILED = "FAILED"
+STOPPED = "STOPPED"
+
+_MAX_LOG_BYTES = 1_000_000
+
+
+class _JobSupervisor:
+    """Detached actor owning one job's subprocess."""
+
+    def __init__(self, submission_id: str, entrypoint: str,
+                 env_vars: Optional[Dict[str, str]] = None):
+        self.submission_id = submission_id
+        self.entrypoint = entrypoint
+        self.env_vars = env_vars or {}
+        self._proc: Optional[subprocess.Popen] = None
+        self._log = b""
+        self._status = PENDING
+        self._lock = threading.Lock()
+        threading.Thread(target=self._run, daemon=True,
+                         name=f"job-{submission_id}").start()
+
+    def _kv_update(self) -> None:
+        from ray_tpu._private import worker as wm
+
+        w = wm.global_worker()
+        with self._lock:
+            payload = json.dumps({
+                "submission_id": self.submission_id,
+                "entrypoint": self.entrypoint,
+                "status": self._status,
+            }).encode()
+            log = self._log[-_MAX_LOG_BYTES:]
+        w.loop_thread.run(w.gcs_client.call(
+            "kv_put", key=f"job:{self.submission_id}", value=payload))
+        w.loop_thread.run(w.gcs_client.call(
+            "kv_put", key=f"job_logs:{self.submission_id}", value=log))
+
+    def _run(self) -> None:
+        env = dict(os.environ)
+        env.update(self.env_vars)
+        gcs = os.environ.get("RAY_TPU_GCS_ADDR")
+        if gcs:
+            env["RAY_TPU_ADDRESS"] = gcs
+        with self._lock:
+            self._status = RUNNING
+        try:
+            self._kv_update()
+            self._proc = subprocess.Popen(
+                self.entrypoint, shell=True, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                start_new_session=True)
+            for line in self._proc.stdout:
+                with self._lock:
+                    self._log = (self._log + line)[-_MAX_LOG_BYTES:]
+            rc = self._proc.wait()
+            with self._lock:
+                if self._status != STOPPED:
+                    self._status = SUCCEEDED if rc == 0 else FAILED
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self._log += f"\nsupervisor error: {e}".encode()
+                self._status = FAILED
+        self._kv_update()
+
+    def status(self) -> str:
+        with self._lock:
+            return self._status
+
+    def logs(self) -> bytes:
+        with self._lock:
+            return self._log
+
+    def stop(self) -> str:
+        with self._lock:
+            self._status = STOPPED
+        if self._proc is not None and self._proc.poll() is None:
+            try:
+                os.killpg(self._proc.pid, 15)
+            except Exception:
+                self._proc.terminate()
+        self._kv_update()
+        return STOPPED
+
+
+class JobSubmissionClient:
+    """reference: python/ray/job_submission/JobSubmissionClient."""
+
+    def __init__(self, address: Optional[str] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[Dict[str, Any]] = None) -> str:
+        submission_id = submission_id or f"raysubmit_{uuid.uuid4().hex[:12]}"
+        env_vars = (runtime_env or {}).get("env_vars") or {}
+        Supervisor = ray_tpu.remote(_JobSupervisor)
+        Supervisor.options(
+            name=f"_job_supervisor:{submission_id}", lifetime="detached",
+            num_cpus=0.1,
+        ).remote(submission_id, entrypoint, env_vars)
+        return submission_id
+
+    def _kv_get(self, key: str):
+        from ray_tpu._private import worker as wm
+
+        w = wm.global_worker()
+        return w.loop_thread.run(w.gcs_client.call("kv_get", key=key))
+
+    def get_job_status(self, submission_id: str) -> str:
+        # Prefer the live supervisor; fall back to the KV record.
+        try:
+            sup = ray_tpu.get_actor(f"_job_supervisor:{submission_id}")
+            return ray_tpu.get(sup.status.remote(), timeout=30)
+        except Exception:
+            pass
+        raw = self._kv_get(f"job:{submission_id}")
+        if raw is None:
+            raise ValueError(f"no such job {submission_id!r}")
+        return json.loads(bytes(raw))["status"]
+
+    def get_job_logs(self, submission_id: str) -> str:
+        try:
+            sup = ray_tpu.get_actor(f"_job_supervisor:{submission_id}")
+            return bytes(ray_tpu.get(sup.logs.remote(),
+                                     timeout=30)).decode(errors="replace")
+        except Exception:
+            raw = self._kv_get(f"job_logs:{submission_id}")
+            return bytes(raw or b"").decode(errors="replace")
+
+    def stop_job(self, submission_id: str) -> bool:
+        sup = ray_tpu.get_actor(f"_job_supervisor:{submission_id}")
+        return ray_tpu.get(sup.stop.remote(), timeout=30) == STOPPED
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        from ray_tpu._private import worker as wm
+
+        w = wm.global_worker()
+        keys = w.loop_thread.run(
+            w.gcs_client.call("kv_keys", prefix="job:"))
+        out = []
+        for k in keys:
+            raw = self._kv_get(k)
+            if raw is not None:
+                out.append(json.loads(bytes(raw)))
+        return out

@@ -1,0 +1,62 @@
+"""Platform services: dashboard HTTP API + job submission (reference:
+dashboard/modules/job, python/ray/dashboard)."""
+
+import json
+import time
+import urllib.request
+
+import ray_tpu
+
+
+def test_dashboard_endpoints(ray_start_regular):
+    from ray_tpu.dashboard import start_dashboard
+
+    port = start_dashboard()
+    assert port
+
+    @ray_tpu.remote
+    class Probe:
+        def ping(self):
+            return 1
+
+    a = Probe.options(name="dash-probe").remote()
+    assert ray_tpu.get(a.ping.remote()) == 1
+
+    def fetch(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=60) as r:
+            return json.loads(r.read())
+
+    assert fetch("/healthz") == "ok"
+    summary = fetch("/api/summary")
+    assert summary["nodes_alive"] >= 1
+    actors = fetch("/api/actors")
+    assert any(x.get("name") == "dash-probe" for x in actors)
+    nodes = fetch("/api/nodes")
+    assert nodes and nodes[0]["alive"]
+
+
+def test_job_submission_lifecycle(ray_start_regular):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient()
+    ok_id = client.submit_job(
+        entrypoint="python -c \"print('hello-from-job')\"",
+        runtime_env={"env_vars": {"JAX_PLATFORMS": "cpu"}})
+    bad_id = client.submit_job(entrypoint="python -c 'import sys; sys.exit(3)'")
+
+    def wait_status(job_id, want, timeout=120):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            s = client.get_job_status(job_id)
+            if s == want:
+                return s
+            time.sleep(0.5)
+        raise AssertionError(
+            f"job {job_id} stuck in {client.get_job_status(job_id)}")
+
+    assert wait_status(ok_id, "SUCCEEDED") == "SUCCEEDED"
+    assert "hello-from-job" in client.get_job_logs(ok_id)
+    assert wait_status(bad_id, "FAILED") == "FAILED"
+    jobs = {j["submission_id"]: j["status"] for j in client.list_jobs()}
+    assert jobs[ok_id] == "SUCCEEDED" and jobs[bad_id] == "FAILED"
