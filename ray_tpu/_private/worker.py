@@ -1956,24 +1956,34 @@ class Worker:
     async def _rpc_ping(self) -> str:
         return "pong"
 
-    async def _cancel_pending(self, spec: TaskSpec) -> None:
-        """Best-effort cancel: tell the executor (if dispatched) and fail the
-        pending task locally (reference: CoreWorker::CancelTask)."""
-        import pickle as _p
-
+    async def _cancel_pending(self, spec: TaskSpec,
+                              force: bool = False) -> None:
+        """Cancel a pending/running task (reference: CoreWorker::CancelTask).
+        Non-force flags the executor so the task is skipped if it hasn't
+        started. force=True additionally KILLS the executing worker process
+        (the only way to stop arbitrary running Python, matching the
+        reference's force_kill) — the lease/reap machinery cleans up."""
         pt_addr = None
         with self.task_manager._lock:
             pt = self.task_manager._pending.get(spec.task_id)
             if pt is not None:
                 pt_addr = pt.inflight_on
         if pt_addr is not None:
+            client = None
             try:
                 client = RpcClient(*pt_addr, name="cancel")
                 await client.call("cancel_task", task_id=spec.task_id.binary(),
                                   timeout=5)
-                await client.close()
+                if force:
+                    await client.notify("exit_worker")
             except Exception:
                 pass
+            finally:
+                if client is not None:
+                    try:
+                        await client.close()
+                    except Exception:
+                        pass
         self.task_manager.fail_permanently(
             spec.task_id,
             ser.serialize_error(TaskCancelledError(spec.function_name)))

@@ -178,7 +178,7 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> None:
     spec = w.task_manager.get_spec(ref.id.task_id())
     if spec is None:
         return
-    w.loop_thread.run(w._cancel_pending(spec))
+    w.loop_thread.run(w._cancel_pending(spec, force=force))
 
 
 def get_actor(name: str) -> ActorHandle:

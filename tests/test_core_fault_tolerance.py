@@ -171,3 +171,25 @@ def test_memory_monitor_kills_leased_worker():
     out = subprocess.run([sys.executable, "-c", OOM_SCRIPT], env=env,
                          capture_output=True, text=True, timeout=240)
     assert "OOM_KILLED" in out.stdout, out.stdout[-500:] + out.stderr[-1500:]
+
+
+def test_force_cancel_kills_running_task(ray_start_regular):
+    """ray.cancel(force=True) stops already-RUNNING work by killing the
+    executor (reference: CancelTask force_kill; round-1 cancel was
+    pre-execution only)."""
+    import time as _t
+
+    @ray_tpu.remote
+    def stuck():
+        import time
+
+        time.sleep(120)
+        return "finished"
+
+    ref = stuck.options(max_retries=0).remote()
+    _t.sleep(1.5)  # ensure it is executing
+    t0 = _t.time()
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert _t.time() - t0 < 20  # did not wait out the 120s sleep
