@@ -213,7 +213,7 @@ def paged_attention_decode_kernel(
         q: jax.Array, k_pages: jax.Array, v_pages: jax.Array,
         page_table: jax.Array, seq_lens: jax.Array,
         scale: Optional[float] = None,
-        pages_per_chunk: int = 8,
+        pages_per_chunk: int = 16,
         interpret: Optional[bool] = None) -> jax.Array:
     """Pallas decode attention: q [B,1,H,D] over paged KV without
     materializing the gathered context. Grid (B, KV_H); q heads are grouped
