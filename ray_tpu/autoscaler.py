@@ -1,0 +1,162 @@
+"""Autoscaler (reference: python/ray/autoscaler — v1 StandardAutoscaler
+reconciling load vs config through cloud NodeProviders; 42k LoC there, the
+reconcile core here).
+
+Redesign: the demand signal is what the GCS already knows — PENDING
+placement groups and PENDING actors (unschedulable work) — reconciled
+against a pluggable NodeProvider. Scale-up launches nodes to satisfy
+demand up to max_workers; scale-down terminates nodes that have stayed
+idle (no leased workers) past idle_timeout_s, down to min_workers. The
+provider abstraction is where a TPU-pod provider (QueuedResources/GKE)
+slots in; LocalNodeProvider spawns real nodelet subprocesses and is what
+the tests and single-host deployments use."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class NodeProvider:
+    """Reference: autoscaler/node_provider.py — create/terminate/list."""
+
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        raise NotImplementedError
+
+    def terminate_node(self, node: Any) -> None:
+        raise NotImplementedError
+
+    def nodes(self) -> List[Any]:
+        raise NotImplementedError
+
+
+class LocalNodeProvider(NodeProvider):
+    """Spawns worker nodes as local nodelet subprocesses (reference:
+    fake_multi_node provider — autoscaler e2e without a cloud)."""
+
+    def __init__(self, head_node, default_resources: Optional[Dict[str, float]] = None,
+                 object_store_memory: int = 128 * 1024 * 1024):
+        self.head_node = head_node
+        self.default_resources = default_resources or {"CPU": 2.0}
+        self.object_store_memory = object_store_memory
+        self._nodes: List[Any] = []
+        self._counter = 0
+
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        from ray_tpu._private.node import Node
+
+        self._counter += 1
+        merged = dict(self.default_resources)
+        for k, v in (resources or {}).items():
+            merged[k] = max(merged.get(k, 0.0), float(v))
+        node = Node(head=False, gcs_address=self.head_node.gcs_address,
+                    resources=merged,
+                    object_store_memory=self.object_store_memory,
+                    session_dir=self.head_node.session_dir,
+                    node_name=f"autoscaled-{self._counter}")
+        self._nodes.append(node)
+        return node
+
+    def terminate_node(self, node: Any) -> None:
+        try:
+            node.shutdown()
+        finally:
+            if node in self._nodes:
+                self._nodes.remove(node)
+
+    def nodes(self) -> List[Any]:
+        return list(self._nodes)
+
+
+class Autoscaler:
+    """Reconcile loop (reference: _private/autoscaler.py:172
+    StandardAutoscaler.update, run from the monitor process)."""
+
+    def __init__(self, provider: NodeProvider, *, min_workers: int = 0,
+                 max_workers: int = 4, idle_timeout_s: float = 60.0,
+                 interval_s: float = 2.0):
+        self.provider = provider
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._idle_since: Dict[str, float] = {}
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- demand / reconcile --------------------------------------------
+    def _pending_demand(self) -> List[Dict[str, float]]:
+        """Resource shapes the cluster cannot currently place."""
+        from ray_tpu.util import state
+
+        demand: List[Dict[str, float]] = []
+        try:
+            for pg in state.list_placement_groups():
+                if pg.get("state") == "PENDING":
+                    demand.extend(pg.get("bundles", []))
+            for actor in state.list_actors(state="PENDING_CREATION"):
+                demand.append({"CPU": 1.0})
+        except Exception:
+            logger.exception("autoscaler demand poll failed")
+        return demand
+
+    def update(self) -> None:
+        """One reconcile step (public for tests)."""
+        demand = self._pending_demand()
+        n = len(self.provider.nodes())
+        if demand and n < self.max_workers:
+            shape: Dict[str, float] = {}
+            for b in demand:
+                for k, v in b.items():
+                    shape[k] = max(shape.get(k, 0.0), float(v))
+            logger.info("autoscaler: %d pending bundles; launching node %s",
+                        len(demand), shape)
+            self.provider.create_node(shape)
+            return
+        # Scale down idle nodes.
+        if n <= self.min_workers:
+            return
+        try:
+            from ray_tpu.util import state
+
+            busy_nodes = {w["node_id"] for w in state.list_workers()
+                          if w.get("leased")}
+        except Exception:
+            return
+        now = time.monotonic()
+        for node in list(self.provider.nodes()):
+            nid = getattr(node, "node_id_hex", None) or id(node)
+            key = str(nid)
+            if key in busy_nodes:
+                self._idle_since.pop(key, None)
+                continue
+            first = self._idle_since.setdefault(key, now)
+            if (now - first > self.idle_timeout_s
+                    and len(self.provider.nodes()) > self.min_workers):
+                logger.info("autoscaler: terminating idle node %s", key)
+                self.provider.terminate_node(node)
+                self._idle_since.pop(key, None)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+            self._stop.wait(self.interval_s)

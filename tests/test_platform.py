@@ -60,3 +60,4 @@ def test_job_submission_lifecycle(ray_start_regular):
     assert wait_status(bad_id, "FAILED") == "FAILED"
     jobs = {j["submission_id"]: j["status"] for j in client.list_jobs()}
     assert jobs[ok_id] == "SUCCEEDED" and jobs[bad_id] == "FAILED"
+
