@@ -204,6 +204,35 @@ class Dataset:
         for block in self.iter_blocks():
             yield from block_to_items(block)
 
+    def limit(self, n: int) -> "Dataset":
+        """Lazy row-count truncation (stops pulling upstream once filled)."""
+        parent = self
+
+        def gen():
+            remaining = n
+            for block in parent.iter_blocks():
+                if remaining <= 0:
+                    return
+                rows = block_num_rows(block)
+                if rows <= remaining:
+                    yield block
+                    remaining -= rows
+                else:
+                    yield block_slice(block, 0, remaining)
+                    return
+
+        return Dataset([_Source(gen, name="Limit")])
+
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           drop_last: bool = False) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (reference: iter_torch_batches)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield {k: torch.as_tensor(np.ascontiguousarray(v))
+                   for k, v in batch.items()}
+
     def take(self, limit: int = 20) -> List[Any]:
         out: List[Any] = []
         for row in self.iter_rows():

@@ -1,0 +1,80 @@
+"""ResNet in flax (second model family; BASELINE config 5 — PBT of a
+ResNet across trials — uses it).
+
+TPU-first notes: NHWC layout (TPU conv native), bf16 activations with f32
+batch-norm statistics, and channel counts in MXU-friendly multiples."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ResidualBlock(nn.Module):
+    channels: int
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        norm = lambda name: nn.BatchNorm(
+            use_running_average=not train, dtype=jnp.float32, name=name)
+        conv = lambda c, k, s, name: nn.Conv(
+            c, (k, k), strides=(s, s), padding="SAME", use_bias=False,
+            dtype=self.dtype, name=name)
+        residual = x
+        y = conv(self.channels, 3, self.stride, "conv1")(x)
+        y = nn.relu(norm("bn1")(y).astype(self.dtype))
+        y = conv(self.channels, 3, 1, "conv2")(y)
+        y = norm("bn2")(y).astype(self.dtype)
+        if residual.shape != y.shape:
+            residual = conv(self.channels, 1, self.stride, "proj")(x)
+            residual = norm("bn_proj")(residual).astype(self.dtype)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """Configurable-depth ResNet (stage_sizes=(2,2,2,2) ≈ ResNet-18;
+    (3,4,6,3) ≈ ResNet-34 topology with basic blocks)."""
+
+    num_classes: int
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding="SAME",
+                    use_bias=False, dtype=self.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32,
+                         name="stem_bn")(x).astype(self.dtype)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n_blocks in enumerate(self.stage_sizes):
+            channels = self.width * (2 ** i)
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and i > 0) else 1
+                x = ResidualBlock(channels, stride, self.dtype,
+                                  name=f"stage{i}_block{b}")(x, train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(x)
+
+    @staticmethod
+    def tiny(num_classes: int = 10) -> "ResNet":
+        """Test-sized: 8-wide, one block per stage, f32."""
+        return ResNet(num_classes=num_classes, stage_sizes=(1, 1),
+                      width=8, dtype=jnp.float32)
+
+    @staticmethod
+    def resnet18(num_classes: int = 1000) -> "ResNet":
+        return ResNet(num_classes=num_classes, stage_sizes=(2, 2, 2, 2))
+
+    @staticmethod
+    def resnet50ish(num_classes: int = 1000) -> "ResNet":
+        # Basic-block depth matching ResNet-34/50 compute class.
+        return ResNet(num_classes=num_classes, stage_sizes=(3, 4, 6, 3))

@@ -156,3 +156,14 @@ def test_sort_and_groupby(ray_start_regular):
         lambda g: {"k": int(g["k"][0]),
                    "span": float(g["v"].max() - g["v"].min())})
     assert all(r["span"] == 27.0 for r in spans.take_all())
+
+
+def test_limit_and_torch_batches(ray_start_regular):
+    ds = rd.range(1000, block_rows=100)
+    assert ds.limit(250).count() == 250
+    # limit is lazy: only enough upstream blocks are pulled.
+    import torch
+
+    batches = list(ds.limit(130).iter_torch_batches(batch_size=64))
+    assert isinstance(batches[0]["id"], torch.Tensor)
+    assert sum(len(b["id"]) for b in batches) == 130

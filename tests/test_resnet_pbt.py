@@ -1,0 +1,90 @@
+"""ResNet model family + PBT-of-ResNet (BASELINE config 5 shape:
+population-based training of ResNet trials)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu.models.resnet import ResNet  # noqa: E402
+
+
+def test_resnet_forward_and_grad():
+    model = ResNet.tiny(num_classes=10)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    vars_ = model.init(jax.random.PRNGKey(0), x, train=True)
+
+    def loss_fn(params):
+        logits, _ = model.apply(
+            {"params": params, "batch_stats": vars_["batch_stats"]}, x,
+            train=True, mutable=["batch_stats"])
+        return jnp.mean(logits ** 2)
+
+    logits = model.apply(vars_, x, train=False)
+    assert logits.shape == (2, 10)
+    g = jax.grad(loss_fn)(vars_["params"])
+    assert all(np.isfinite(np.asarray(leaf)).all()
+               for leaf in jax.tree.leaves(g))
+
+
+def test_pbt_resnet_trials(ray_start_regular, tmp_path):
+    """BASELINE config 5 shape: PBT mutates lr across ResNet trials."""
+    from ray_tpu import tune
+
+    def trainable(config):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ray_tpu.models.resnet import ResNet
+
+        model = ResNet.tiny(num_classes=4)
+        x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((8, 16, 16, 3)),
+            jnp.float32)
+        y = jnp.asarray([0, 1, 2, 3] * 2)
+        vars_ = model.init(jax.random.PRNGKey(0), x, train=True)
+        params, bstats = vars_["params"], vars_["batch_stats"]
+        opt = optax.sgd(config["lr"])
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, bstats, opt_state):
+            def loss_fn(p):
+                logits, updates = model.apply(
+                    {"params": p, "batch_stats": bstats}, x, train=True,
+                    mutable=["batch_stats"])
+                onehot = jax.nn.one_hot(y, 4)
+                return optax.softmax_cross_entropy(
+                    logits, onehot).mean(), updates
+
+            (loss, updates), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            upd, opt_state = opt.update(grads, opt_state)
+            return (optax.apply_updates(params, upd),
+                    updates["batch_stats"], opt_state, loss)
+
+        for it in range(6):
+            params, bstats, opt_state, loss = step(params, bstats, opt_state)
+            tune.report({"loss": float(loss)})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([1e-4, 1e-2, 0.1, 0.5])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=2,
+            scheduler=tune.PopulationBasedTraining(
+                metric="loss", mode="min", perturbation_interval=2,
+                hyperparam_mutations={"lr": tune.loguniform(1e-4, 0.5)},
+                seed=0)),
+        run_config=tune.TuneRunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4 and not grid.errors
+    best = grid.get_best_result()
+    assert np.isfinite(best.metrics["loss"])
