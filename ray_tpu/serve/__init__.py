@@ -39,7 +39,8 @@ class Deployment:
                  ray_actor_options: Optional[Dict[str, Any]] = None,
                  max_ongoing_requests: int = 16,
                  user_config: Optional[Dict[str, Any]] = None,
-                 route_prefix: Optional[str] = None):
+                 route_prefix: Optional[str] = None,
+                 autoscaling_config: Optional[Dict[str, Any]] = None):
         self._ctor = ctor
         self.name = name
         self.num_replicas = num_replicas
@@ -47,13 +48,15 @@ class Deployment:
         self.max_ongoing_requests = max_ongoing_requests
         self.user_config = user_config
         self.route_prefix = route_prefix
+        self.autoscaling_config = autoscaling_config
 
     def options(self, **overrides) -> "Deployment":
         cfg = dict(
             name=self.name, num_replicas=self.num_replicas,
             ray_actor_options=self.ray_actor_options,
             max_ongoing_requests=self.max_ongoing_requests,
-            user_config=self.user_config, route_prefix=self.route_prefix)
+            user_config=self.user_config, route_prefix=self.route_prefix,
+            autoscaling_config=self.autoscaling_config)
         cfg.update(overrides)
         return Deployment(self._ctor, **cfg)
 
@@ -125,7 +128,8 @@ def run(target: Application, *, name: str = "default",
                  ray_actor_options=dep.ray_actor_options,
                  max_ongoing_requests=dep.max_ongoing_requests,
                  user_config=dep.user_config,
-                 route_prefix=prefix)), timeout=120)
+                 route_prefix=prefix,
+                 autoscaling_config=dep.autoscaling_config)), timeout=120)
     handle = DeploymentHandle(apps[0][0].deployment.name)
     # Wait until the root deployment has live replicas (and release the
     # probe's outstanding slot so routing stays unbiased).

@@ -19,6 +19,11 @@ class DeploymentConfig:
     version: int = 0
     user_config: Optional[Dict[str, Any]] = None
     health_check_period_s: float = 2.0
+    # Queue-driven replica autoscaling (reference: serve autoscaling_policy
+    # + autoscaling_state): desired = ceil(total_ongoing / target), clamped
+    # to [min_replicas, max_replicas]; scale-down requires several
+    # consecutive low readings (cooldown).
+    autoscaling_config: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass

@@ -31,6 +31,7 @@ class ServeController:
         self._version = 0
         self._running = False
         self._http_port: Optional[int] = None
+        self._downscale_streak: Dict[str, int] = {}
         self._lock = threading.RLock()
 
     def start_loops(self) -> None:
@@ -130,6 +131,38 @@ class ServeController:
                 logger.exception("reconcile failed")
             time.sleep(1.0)
 
+    def _autoscale(self, name: str, cfg: DeploymentConfig,
+                   replicas) -> None:
+        ac = cfg.autoscaling_config
+        if not ac or not replicas:
+            return
+        target = max(0.1, float(ac.get("target_ongoing_requests", 1.0)))
+        lo = int(ac.get("min_replicas", 1))
+        hi = int(ac.get("max_replicas", max(lo, cfg.num_replicas)))
+        total = 0
+        for info in list(replicas):
+            try:
+                total += ray_tpu.get(
+                    info.actor.num_ongoing_requests.remote(), timeout=10)
+            except Exception:
+                pass
+        desired = max(lo, min(hi, -(-int(total) // int(target)) or lo))
+        if desired > cfg.num_replicas:
+            logger.info("autoscaling %s: %d ongoing -> %d replicas", name,
+                        total, desired)
+            cfg.num_replicas = desired
+            self._downscale_streak.pop(name, None)
+        elif desired < cfg.num_replicas:
+            streak = self._downscale_streak.get(name, 0) + 1
+            self._downscale_streak[name] = streak
+            if streak >= 5:  # ~5 reconcile periods of low load
+                logger.info("autoscaling %s: idle -> %d replicas", name,
+                            desired)
+                cfg.num_replicas = desired
+                self._downscale_streak[name] = 0
+        else:
+            self._downscale_streak.pop(name, None)
+
     def _reconcile_once(self, health_check: bool = False) -> None:
         from ray_tpu.serve._replica import ReplicaActor
 
@@ -140,6 +173,7 @@ class ServeController:
             cfg: DeploymentConfig = d["config"]
             replicas = self._replicas.setdefault(name, [])
             if health_check:
+                self._autoscale(name, cfg, replicas)
                 for info in list(replicas):
                     try:
                         ray_tpu.get(info.actor.check_health.remote(),

@@ -27,6 +27,10 @@ class ReplicaActor:
             reconfigure = getattr(self._callable, "reconfigure", None)
             if callable(reconfigure):
                 reconfigure(user_config)
+        import threading
+
+        self._ongoing = 0
+        self._ongoing_lock = threading.Lock()
 
     def _resolve_method(self, method_name: str):
         if callable(self._callable) and method_name == "__call__":
@@ -38,16 +42,37 @@ class ReplicaActor:
 
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
         """Streaming entry (called with num_returns="dynamic")."""
-        result = self._resolve_method(method_name)(*args, **kwargs)
-        if inspect.isgenerator(result):
-            # Streamed via num_returns="dynamic" at the call site.
-            yield from result
-            return
-        yield result
+        with self._track():
+            result = self._resolve_method(method_name)(*args, **kwargs)
+            if inspect.isgenerator(result):
+                # Streamed via num_returns="dynamic" at the call site.
+                yield from result
+                return
+            yield result
 
     def handle_request_unary(self, method_name: str, args: Tuple,
                              kwargs: Dict):
-        return self._resolve_method(method_name)(*args, **kwargs)
+        with self._track():
+            return self._resolve_method(method_name)(*args, **kwargs)
+
+    def _track(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            with self._ongoing_lock:
+                self._ongoing += 1
+            try:
+                yield
+            finally:
+                with self._ongoing_lock:
+                    self._ongoing -= 1
+
+        return cm()
+
+    def num_ongoing_requests(self) -> int:
+        with self._ongoing_lock:
+            return self._ongoing
 
     def reconfigure(self, user_config: Dict[str, Any]) -> None:
         reconfigure = getattr(self._callable, "reconfigure", None)

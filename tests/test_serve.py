@@ -120,3 +120,47 @@ def test_replica_recovery_after_kill(serve_instance):
             time.sleep(1.0)
     else:
         pytest.fail("replica never recovered")
+
+
+def test_autoscaling_scales_replicas_up(serve_instance):
+    """Queue-driven replica autoscaling (reference: serve
+    autoscaling_policy): sustained concurrent slow requests push the
+    deployment past one replica."""
+    import threading
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=16,
+                      autoscaling_config={"min_replicas": 1,
+                                          "max_replicas": 3,
+                                          "target_ongoing_requests": 1})
+    class Slow:
+        def __call__(self, request):
+            time.sleep(0.5)
+            return "ok"
+
+    handle = serve.run(Slow.bind())
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                handle.remote({}).result(timeout=60)
+            except Exception:
+                pass
+
+    threads = [threading.Thread(target=hammer) for _ in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        deadline = time.time() + 60
+        scaled = False
+        while time.time() < deadline:
+            st = serve.status().get("Slow", {})
+            if st.get("running", 0) >= 2:
+                scaled = True
+                break
+            time.sleep(1.0)
+        assert scaled, serve.status()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
