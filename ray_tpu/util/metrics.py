@@ -1,0 +1,179 @@
+"""User metrics API: Counter / Gauge / Histogram (reference:
+ray.util.metrics -> Cython metric.pxi -> OpenCensus -> per-node agent ->
+Prometheus; here the aggregation floor: per-process metric registries
+flushed into the GCS KV and merged by the state reader).
+
+Each process flushes its own snapshot under `metrics:<pid-uuid>`; readers
+merge across processes (counters sum, gauges take the freshest, histogram
+buckets sum). No exporter daemon needed to scrape: anything that can call
+the state API (CLI, dashboard) can read cluster metrics."""
+
+from __future__ import annotations
+
+import bisect
+import pickle
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+_FLUSH_INTERVAL_S = 2.0
+
+_registry_lock = threading.Lock()
+_registry: List["_Metric"] = []
+_flusher_started = False
+_process_key = f"metrics:{uuid.uuid4().hex[:12]}"
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class _Metric:
+    kind = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, Any] = {}
+        with _registry_lock:
+            _registry.append(self)
+        _ensure_flusher()
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "name": self.name,
+                "kind": self.kind,
+                "description": self.description,
+                "values": dict(self._values),
+                "ts": time.time(),
+            }
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        k = _tags_key(tags)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float,
+            tags: Optional[Dict[str, str]] = None) -> None:
+        with self._lock:
+            self._values[_tags_key(tags)] = float(value)
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = DEFAULT_BUCKETS,
+                 tag_keys: Sequence[str] = ()):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = tuple(boundaries)
+
+    def observe(self, value: float,
+                tags: Optional[Dict[str, str]] = None) -> None:
+        k = _tags_key(tags)
+        with self._lock:
+            buckets = self._values.setdefault(
+                k, {"boundaries": self.boundaries,
+                    "counts": [0] * (len(self.boundaries) + 1),
+                    "sum": 0.0, "count": 0})
+            buckets["counts"][bisect.bisect_left(self.boundaries, value)] += 1
+            buckets["sum"] += value
+            buckets["count"] += 1
+
+
+# ---------------------------------------------------------------------------
+def _flush_once() -> None:
+    from ray_tpu._private import worker as wm
+
+    w = wm._global_worker  # avoid creating a worker just to flush
+    if w is None or not w.connected:
+        return
+    with _registry_lock:
+        snaps = [m.snapshot() for m in _registry]
+    if not snaps:
+        return
+    payload = pickle.dumps(snaps, protocol=5)
+    w.loop_thread.run(w.gcs_client.call(
+        "kv_put", key=_process_key, value=payload))
+
+
+def _ensure_flusher() -> None:
+    global _flusher_started
+    with _registry_lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+
+    def loop():
+        while True:
+            time.sleep(_FLUSH_INTERVAL_S)
+            try:
+                _flush_once()
+            except Exception:
+                pass
+
+    threading.Thread(target=loop, daemon=True, name="metrics-flush").start()
+
+
+def flush() -> None:
+    """Force a flush (tests / shutdown paths)."""
+    _flush_once()
+
+
+def query_metrics() -> Dict[str, Dict[str, Any]]:
+    """Cluster-wide merged view {metric_name: {kind, values}} (counters
+    sum across processes; gauges keep the freshest; histograms merge)."""
+    from ray_tpu._private import worker as wm
+
+    w = wm.global_worker()
+    keys = w.loop_thread.run(w.gcs_client.call("kv_keys", prefix="metrics:"))
+    merged: Dict[str, Dict[str, Any]] = {}
+    freshest: Dict[Tuple[str, Tuple], float] = {}
+    for key in keys:
+        raw = w.loop_thread.run(w.gcs_client.call("kv_get", key=key))
+        if raw is None:
+            continue
+        for snap in pickle.loads(bytes(raw)):
+            m = merged.setdefault(snap["name"], {
+                "kind": snap["kind"],
+                "description": snap["description"],
+                "values": {},
+            })
+            for tags, val in snap["values"].items():
+                if snap["kind"] == "counter":
+                    m["values"][tags] = m["values"].get(tags, 0.0) + val
+                elif snap["kind"] == "gauge":
+                    fk = (snap["name"], tags)
+                    if snap["ts"] >= freshest.get(fk, 0.0):
+                        freshest[fk] = snap["ts"]
+                        m["values"][tags] = val
+                else:
+                    cur = m["values"].get(tags)
+                    if cur is None:
+                        m["values"][tags] = {
+                            "boundaries": val["boundaries"],
+                            "counts": list(val["counts"]),
+                            "sum": val["sum"], "count": val["count"]}
+                    else:
+                        cur["counts"] = [a + b for a, b in
+                                         zip(cur["counts"], val["counts"])]
+                        cur["sum"] += val["sum"]
+                        cur["count"] += val["count"]
+    return merged

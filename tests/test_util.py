@@ -56,3 +56,41 @@ def test_cli_status(ray_start_regular):
     assert out.returncode == 0, out.stderr[-500:]
     summary = json.loads(out.stdout)
     assert summary["nodes_alive"] >= 1
+
+
+def test_user_metrics_counter_gauge_histogram(ray_start_regular):
+    """ray_tpu.util.metrics: per-process metrics merge cluster-wide through
+    the GCS (reference: ray.util.metrics -> metrics agent -> Prometheus)."""
+    from ray_tpu.util import metrics
+
+    c = metrics.Counter("test_requests", tag_keys=("route",))
+    g = metrics.Gauge("test_queue_depth")
+    h = metrics.Histogram("test_latency", boundaries=(0.1, 1.0))
+    for _ in range(5):
+        c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/b"})
+    g.set(7.0)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(3.0)
+    metrics.flush()
+
+    # A remote worker contributes to the same counter.
+    @ray_tpu.remote
+    def bump():
+        from ray_tpu.util import metrics as m
+
+        cc = m.Counter("test_requests", tag_keys=("route",))
+        cc.inc(10.0, tags={"route": "/a"})
+        m.flush()
+        return True
+
+    assert ray_tpu.get(bump.remote(), timeout=60)
+
+    merged = metrics.query_metrics()
+    reqs = merged["test_requests"]["values"]
+    assert reqs[(("route", "/a"),)] == 15.0
+    assert reqs[(("route", "/b"),)] == 2.0
+    assert merged["test_queue_depth"]["values"][()] == 7.0
+    hist = merged["test_latency"]["values"][()]
+    assert hist["count"] == 3 and hist["counts"] == [1, 1, 1]
