@@ -112,6 +112,9 @@ class TaskSpec:
     # Runtime env (serialized dict) — hashed for worker-pool keying.
     runtime_env: Optional[Dict[str, Any]] = None
     placement_group_id: Optional[PlacementGroupID] = None
+    # "" = normal object plane; "device" = returns stay in the executor's HBM
+    # and move via the device-object plane (experimental/device_objects.py).
+    tensor_transport: str = ""
 
     def scheduling_key(self) -> Tuple:
         """Lease-reuse key (reference: SchedulingKey in

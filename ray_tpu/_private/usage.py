@@ -1,0 +1,58 @@
+"""Usage stats (reference: python/ray/_private/usage/usage_lib.py — opt-out
+telemetry). This build has zero egress, so the recorder is local-only: it
+aggregates library/feature usage into `usage_stats.json` in the session dir
+(the artifact a real deployment would ship). Opt out with
+RAY_TPU_USAGE_STATS_ENABLED=0."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_usage: Dict[str, int] = {}
+_session_dir: Optional[str] = None
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def set_session_dir(path: str) -> None:
+    global _session_dir
+    _session_dir = path
+
+
+def record_library_usage(name: str) -> None:
+    """Called on first import of each library (train/tune/serve/…)."""
+    if not enabled():
+        return
+    with _lock:
+        _usage[name] = _usage.get(name, 0) + 1
+    _flush()
+
+
+def usage_snapshot() -> Dict[str, int]:
+    with _lock:
+        return dict(_usage)
+
+
+def _flush() -> None:
+    path = _session_dir
+    if not path:
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker_or_none()
+        path = getattr(w, "session_dir", None)
+        if not path:
+            return
+    try:
+        with _lock:
+            payload = {"recorded_at": time.time(), "libraries": dict(_usage)}
+        with open(os.path.join(path, "usage_stats.json"), "w") as f:
+            json.dump(payload, f)
+    except Exception:
+        pass  # telemetry must never break anything

@@ -555,6 +555,7 @@ class Worker:
         self._actor_states: Dict[str, Dict[str, Any]] = {}
         self._actor_pulse = asyncio.Event()
         self._actor_sub_started = False
+        self._log_sub_started = False
         # Task-event buffer (timeline/profiling floor).
         self._task_events: List[Dict[str, Any]] = []
         self._task_events_lock = threading.Lock()
@@ -565,6 +566,37 @@ class Worker:
         self._shutdown = False
         # The task currently executing in this process (execution context).
         self._current_task_id: Optional[TaskID] = None
+        # Device-object plane (experimental/device_objects.py): HBM-resident
+        # tensors this process holds, and src addresses of device objects this
+        # process owns (for the owner-driven free protocol).
+        self._device_object_store: Any = None
+        self.device_object_srcs: Dict[bytes, Tuple[str, int]] = {}
+
+    @property
+    def device_object_store(self):
+        if self._device_object_store is None:
+            from ray_tpu.experimental.device_objects import DeviceObjectStore
+
+            self._device_object_store = DeviceObjectStore()
+        return self._device_object_store
+
+    def _maybe_device(self, value: Any) -> Any:
+        """Materialize device-object skeletons on the local device (no-op for
+        everything else). Must run OFF the event loop."""
+        if type(value).__name__ == "DeviceObjectValue":
+            from ray_tpu.experimental import device_objects as devobj
+
+            if isinstance(value, devobj.DeviceObjectValue):
+                return devobj.resolve_sync(self, value)
+        return value
+
+    async def _maybe_device_async(self, value: Any) -> Any:
+        if type(value).__name__ == "DeviceObjectValue":
+            from ray_tpu.experimental import device_objects as devobj
+
+            if isinstance(value, devobj.DeviceObjectValue):
+                return await devobj.resolve_async(self, value)
+        return value
 
     # ------------------------------------------------------------------
     # Bootstrap
@@ -653,6 +685,18 @@ class Worker:
         s.register("cancel_task", self._rpc_cancel_task)
         s.register("exit_worker", self._rpc_exit_worker)
         s.register("ping", self._rpc_ping)
+        s.register("device_object_fetch", self._rpc_device_object_fetch)
+        s.register("device_object_free", self._rpc_device_object_free)
+
+    async def _rpc_device_object_fetch(self, object_id: bytes) -> Dict[str, Any]:
+        from ray_tpu.experimental import device_objects as devobj
+
+        return await devobj.rpc_fetch(self, object_id)
+
+    async def _rpc_device_object_free(self, object_id: bytes) -> Dict[str, Any]:
+        from ray_tpu.experimental import device_objects as devobj
+
+        return await devobj.rpc_free(self, object_id)
 
     def _gcs_call_sync(self, method: str, **kwargs) -> Any:
         return self.loop_thread.run(
@@ -744,6 +788,10 @@ class Worker:
         return spill_read(self.spill_dir, object_id)
 
     def _on_owned_ref_zero(self, object_id: ObjectID) -> None:
+        if self._device_object_store is not None or self.device_object_srcs:
+            from ray_tpu.experimental import device_objects as devobj
+
+            devobj.on_owner_ref_zero(self, object_id)
         self.memory_store.delete(object_id)
         self.task_manager.drop_lineage(object_id)
         try:
@@ -762,12 +810,16 @@ class Worker:
     # ------------------------------------------------------------------
     # Public API: put / get / wait
     # ------------------------------------------------------------------
-    def put(self, value: Any) -> ObjectRef:
+    def allocate_put_id(self) -> ObjectID:
         with self._put_lock:
             self._put_counter += 1
             idx = self._put_counter
-        task_id = TaskID.for_task(self.job_id)
-        object_id = ObjectID.for_put(task_id, idx)
+        return ObjectID.for_put(TaskID.for_task(self.job_id), idx)
+
+    def put(self, value: Any) -> ObjectRef:
+        return self.put_with_id(self.allocate_put_id(), value)
+
+    def put_with_id(self, object_id: ObjectID, value: Any) -> ObjectRef:
         obj = ser.serialize(value)
         cfg = get_config()
         if obj.total_bytes() > cfg.max_inline_object_size:
@@ -798,7 +850,7 @@ class Worker:
                 value, is_error = ser.deserialize_or_error(obj)
                 if is_error:
                     raise value
-                out.append(value)
+                out.append(self._maybe_device(value))
             return out
         coro = self._get_async(refs, timeout)
         outer = None if timeout is None else timeout + 5
@@ -813,7 +865,7 @@ class Worker:
             value, is_error = ser.deserialize_or_error(obj)
             if is_error:
                 raise value
-            out.append(value)
+            out.append(await self._maybe_device_async(value))
         return out
 
     async def _resolve_ref(self, ref: ObjectRef,
@@ -1057,7 +1109,7 @@ class Worker:
         value, is_error = ser.deserialize_or_error(obj)
         if is_error:
             raise value
-        return value
+        return await self._maybe_device_async(value)
 
     async def await_ref(self, ref: ObjectRef) -> Any:
         """Used by `await ref` inside async actors (same loop)."""
@@ -1229,6 +1281,44 @@ class Worker:
             self._actor_states[actor_id.hex()] = info
         return info
 
+    def start_log_subscriber(self) -> None:
+        """Driver side of the log pipeline (reference: log_monitor.py tails →
+        GCS pubsub → driver stdout): consume the 'logs' channel and echo
+        worker output with a (source, node=…) prefix.
+
+        Known limit: workers here are pooled per runtime-env, not per job, so
+        lines are not job-tagged — with several concurrent drivers each one
+        echoes the whole cluster's worker output (the reference filters on
+        job_id, log_monitor.py)."""
+        if self._log_sub_started:
+            return
+        self._log_sub_started = True
+        self.loop.call_soon_threadsafe(
+            lambda: self.loop.create_task(self._log_sub_loop()))
+
+    async def _log_sub_loop(self) -> None:
+        import sys
+
+        # Subscribe from "now": cursor 0 would replay every retained log
+        # batch from jobs that ran before this driver connected.
+        try:
+            cursor = await self.gcs_client.call("pubsub_seq", channel="logs")
+        except Exception:
+            cursor = 0
+        while not self._shutdown:
+            try:
+                out = await self.gcs_client.call(
+                    "pubsub_poll", cursors={"logs": cursor}, timeout=40.0)
+            except Exception:
+                await asyncio.sleep(1.0)
+                continue
+            for seq, batches in (out or {}).get("logs", []):
+                cursor = max(cursor, seq)
+                for b in batches:
+                    prefix = f"({b.get('source')}, node={b.get('node')})"
+                    for line in b.get("lines", []):
+                        print(f"{prefix} {line}", file=sys.stderr, flush=True)
+
     async def _actor_pubsub_loop(self) -> None:
         """Long-poll the GCS 'actors' channel (reference: the reference's
         pubsub had zero subscribers in round 1 — this makes actor-state
@@ -1382,6 +1472,11 @@ class Worker:
                                 await client.close()
                             except Exception:
                                 pass
+        for ob, src in (reply.get("device_objects") or {}).items():
+            # Owner-side record for the free protocol: when this return ref's
+            # count hits zero we must tell the source actor to drop its HBM
+            # copy (on_owner_ref_zero in experimental/device_objects.py).
+            self.device_object_srcs[ob] = tuple(src)
         if reply.get("cancelled"):
             self.task_manager.fail_permanently(
                 spec.task_id,
@@ -1481,6 +1576,7 @@ class Worker:
         num_returns: int = 1,
         max_task_retries: int = 0,
         concurrency_group: str = "",
+        tensor_transport: str = "",
     ) -> List[ObjectRef]:
         with self._task_counter_lock:
             seq = self._actor_seq_nos.get(actor_id, 0)
@@ -1502,6 +1598,7 @@ class Worker:
             actor_method_name=method_name,
             seq_no=seq,
             concurrency_group=concurrency_group,
+            tensor_transport=tensor_transport,
         )
         return_ids = self.task_manager.add_pending(spec)
         if num_returns == -1:
@@ -1652,8 +1749,7 @@ class Worker:
             try:
                 self._current_task_id = task_spec.task_id
                 result = await method(*args, **kwargs)
-                return self._with_borrows(task_spec, {
-                    "results": self._pack_results(task_spec, result)})
+                return self._reply_results(task_spec, result)
             except BaseException as e:  # noqa: BLE001
                 return {"results": [self._error_result(e)] *
                         max(1, task_spec.num_returns)}
@@ -1674,8 +1770,7 @@ class Worker:
             result = method(*args, **kwargs)
             if spec.num_returns == -1:
                 return self._stream_generator(spec, iter(result))
-            return self._with_borrows(spec, {
-                "results": self._pack_results(spec, result)})
+            return self._reply_results(spec, result)
         except BaseException as e:  # noqa: BLE001
             ok = False
             return {"results": [self._error_result(e)] * max(1, spec.num_returns)}
@@ -1696,8 +1791,7 @@ class Worker:
             result = fn(*args, **kwargs)
             if spec.num_returns == -1:
                 return self._stream_generator(spec, iter(result))
-            return self._with_borrows(spec, {
-                "results": self._pack_results(spec, result)})
+            return self._reply_results(spec, result)
         except BaseException as e:  # noqa: BLE001
             ok = False
             logger.info("task %s raised: %r", spec.function_name, e)
@@ -1732,26 +1826,34 @@ class Worker:
         # Fast path: no ref args → pure deserialization, skip the loop hop.
         if (all(a[0] == "value" for a in spec.args)
                 and all(v[0] == "value" for v in spec.kwargs.values())):
-            return ([ser.deserialize(a[1]) for a in spec.args],
-                    {k: ser.deserialize(v[1]) for k, v in spec.kwargs.items()})
+            return ([self._maybe_device(ser.deserialize(a[1]))
+                     for a in spec.args],
+                    {k: self._maybe_device(ser.deserialize(v[1]))
+                     for k, v in spec.kwargs.items()})
         return self.loop_thread.run(self._resolve_spec_args(spec))
 
     async def _resolve_spec_args(self, spec: TaskSpec) -> Tuple[list, dict]:
         async def one(a):
             if a[0] == "value":
-                return ser.deserialize(a[1])
+                return await self._maybe_device_async(ser.deserialize(a[1]))
             ref = a[1]
             obj = await self._resolve_ref(ref, None)
             value, is_error = ser.deserialize_or_error(obj)
             if is_error:
                 raise value
-            return value
+            return await self._maybe_device_async(value)
 
         args = [await one(a) for a in spec.args]
         kwargs = {k: await one(v) for k, v in spec.kwargs.items()}
         return args, kwargs
 
-    def _pack_results(self, spec: TaskSpec, result: Any) -> List[Any]:
+    def _reply_results(self, spec: TaskSpec, result: Any) -> Dict[str, Any]:
+        reply: Dict[str, Any] = {}
+        reply["results"] = self._pack_results(spec, result, reply)
+        return self._with_borrows(spec, reply)
+
+    def _pack_results(self, spec: TaskSpec, result: Any,
+                      reply: Optional[Dict[str, Any]] = None) -> List[Any]:
         if spec.num_returns == 0:
             return []
         values = (result,) if spec.num_returns == 1 else tuple(result)
@@ -1760,6 +1862,19 @@ class Worker:
                 f"task declared num_returns={spec.num_returns} but returned "
                 f"{len(values)} values")
         cfg = get_config()
+        if spec.tensor_transport == "device":
+            # Returns stay in this process's HBM; only the skeleton travels
+            # (experimental/device_objects.py store_result).
+            from ray_tpu.experimental import device_objects as devobj
+
+            wrapped = []
+            for i, v in enumerate(values):
+                oid = ObjectID.for_task_return(spec.task_id, i)
+                wrapped.append(devobj.store_result(self, oid, v))
+                if reply is not None:
+                    reply.setdefault("device_objects", {})[oid.binary()] = \
+                        tuple(self.address)
+            values = tuple(wrapped)
         out = []
         for i, v in enumerate(values):
             obj = ser.serialize(v)

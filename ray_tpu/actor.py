@@ -12,13 +12,19 @@ from ray_tpu._private.ids import ActorID
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, tensor_transport: str = ""):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._tensor_transport = tensor_transport
 
-    def options(self, num_returns: int = 1, **_ignored) -> "ActorMethod":
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: int = 1, tensor_transport: str = "",
+                **_ignored) -> "ActorMethod":
+        """tensor_transport="device" keeps returned jax.Arrays in the actor's
+        HBM (reference: @ray.method(tensor_transport=...), RDT); see
+        ray_tpu.experimental.device_objects."""
+        return ActorMethod(self._handle, self._method_name, num_returns,
+                           tensor_transport)
 
     def bind(self, *args, **kwargs):
         """Build a DAG node from this method (reference: dag/dag_node.py)."""
@@ -38,6 +44,7 @@ class ActorMethod:
             kwargs,
             num_returns=num_returns,
             max_task_retries=self._handle._max_task_retries,
+            tensor_transport=self._tensor_transport,
         )
         if num_returns in (1, -1):
             return refs[0]
@@ -92,6 +99,12 @@ class ActorClass:
         return ActorClass(self._cls, **merged)
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        from ray_tpu import api
+
+        if api._global_client is not None:
+            # Decorated before init("ray://…"): route through the proxy.
+            return api._global_client.remote(
+                self._cls, **self._options).remote(*args, **kwargs)
         w = worker_mod.global_worker()
         opts = self._options
         resources: Dict[str, float] = dict(opts.get("resources") or {})

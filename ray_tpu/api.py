@@ -22,10 +22,13 @@ from ray_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 _global_node: Optional[Node] = None
+# Remote-driver proxy mode (reference: ray client, "ray://" addresses).
+_global_client: Optional[Any] = None
 
 
 def is_initialized() -> bool:
-    return worker_mod.global_worker_or_none() is not None
+    return (_global_client is not None
+            or worker_mod.global_worker_or_none() is not None)
 
 
 def init(
@@ -41,13 +44,24 @@ def init(
     log_to_driver: bool = True,
     _node_name: str = "",
 ) -> Dict[str, Any]:
-    """Start (or connect to) a cluster and connect this process as a driver."""
-    global _global_node
+    """Start (or connect to) a cluster and connect this process as a driver.
+
+    address="ray://host:port" enters remote-driver (client) mode: this
+    process proxies every operation to a cluster-side ClientProxyServer and
+    needs no shm/cluster access (reference: ray client, util/client/)."""
+    global _global_node, _global_client
     if is_initialized():
         if ignore_reinit_error:
             return {"address": None}
         raise RuntimeError("ray_tpu.init() called twice "
                            "(use ignore_reinit_error=True to allow)")
+
+    if address is not None and address.startswith("ray://"):
+        from ray_tpu.util.client import RayTpuClient
+
+        host, _, port = address[len("ray://"):].partition(":")
+        _global_client = RayTpuClient(host, int(port))
+        return {"address": address, "client": True}
 
     if address is None:
         from ray_tpu._private.accelerators import detect_resources
@@ -101,6 +115,8 @@ def init(
         w.gcs_client.call("add_job", metadata={"namespace": namespace or "",
                                                "pid": os.getpid()}))
     w.job_id = JobID.from_int(job_id_int)
+    if log_to_driver:
+        w.start_log_subscriber()
     logger.info("ray_tpu initialized: gcs=%s job=%s", gcs_address, job_id_int)
     return {
         "address": f"{gcs_address[0]}:{gcs_address[1]}",
@@ -110,7 +126,11 @@ def init(
 
 
 def shutdown() -> None:
-    global _global_node
+    global _global_node, _global_client
+    if _global_client is not None:
+        _global_client.disconnect()
+        _global_client = None
+        return
     w = worker_mod.global_worker_or_none()
     if w is not None:
         try:
@@ -131,6 +151,8 @@ def remote(*args, **options) -> Union[RemoteFunction, ActorClass]:
     name=..., lifetime=..., max_concurrency=...)."""
 
     def decorate(obj):
+        if _global_client is not None:
+            return _global_client.remote(obj, **options)
         if inspect.isclass(obj):
             return ActorClass(obj, **options)
         return RemoteFunction(obj, **options)
@@ -144,6 +166,8 @@ def remote(*args, **options) -> Union[RemoteFunction, ActorClass]:
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None) -> Any:
+    if _global_client is not None:
+        return _global_client.get(refs, timeout=timeout)
     w = worker_mod.global_worker()
     if isinstance(refs, ObjectRef):
         return w.get([refs], timeout)[0]
@@ -151,6 +175,8 @@ def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
 
 
 def put(value: Any) -> ObjectRef:
+    if _global_client is not None:
+        return _global_client.put(value)
     return worker_mod.global_worker().put(value)
 
 
@@ -163,10 +189,15 @@ def wait(
 ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
     if not isinstance(refs, (list, tuple)):
         raise TypeError("ray_tpu.wait() expects a list of ObjectRefs")
+    if _global_client is not None:
+        return _global_client.wait(list(refs), num_returns=num_returns,
+                                   timeout=timeout)
     return worker_mod.global_worker().wait(list(refs), num_returns, timeout)
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    if _global_client is not None:
+        return _global_client.kill(actor)
     w = worker_mod.global_worker()
     w.loop_thread.run(
         w.gcs_client.call("kill_actor", actor_id=actor._actor_id.binary(),

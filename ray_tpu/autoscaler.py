@@ -119,7 +119,17 @@ class Autoscaler:
     def update(self) -> None:
         """One reconcile step (public for tests)."""
         demand = self._pending_demand()
-        n = len(self.provider.nodes())
+        nodes = self.provider.nodes()
+        n = len(nodes)
+        # Launch tracking (reference: node_launcher pending counts): while
+        # async providers (TPU slices) are still provisioning, the demand
+        # that triggered them is still "pending" in the GCS — launching
+        # again would double-provision.
+        provisioning = any(
+            getattr(node, "state", "RUNNING") == "PROVISIONING"
+            for node in nodes)
+        if demand and provisioning:
+            return
         if demand and n < self.max_workers:
             shape: Dict[str, float] = {}
             for b in demand:

@@ -830,6 +830,11 @@ class GcsServer:
     async def rpc_publish(self, channel: str, message: Any) -> None:
         await self.pubsub.publish(channel, message)
 
+    async def rpc_pubsub_seq(self, channel: str) -> int:
+        """Current sequence number of a channel — lets a new subscriber
+        start from "now" instead of replaying the retained backlog."""
+        return self.pubsub._seq.get(channel, 0)
+
     async def rpc_ping(self) -> str:
         return "pong"
 

@@ -162,6 +162,7 @@ class Nodelet:
         self._background.append(asyncio.ensure_future(self._reap_loop()))
         self._background.append(
             asyncio.ensure_future(self._memory_monitor_loop()))
+        self._background.append(asyncio.ensure_future(self._log_monitor_loop()))
         logger.info("nodelet %s on %s:%d resources=%s", self.node_name, *addr,
                     self.resources_total)
         return addr
@@ -186,6 +187,57 @@ class Nodelet:
         for p in (self.store_path, self.store_path + ".pid"):
             if os.path.exists(p):
                 os.unlink(p)
+
+    # ------------------------------------------------------------------
+    # Log pipeline (reference: python/ray/_private/log_monitor.py — tail
+    # worker log files → GCS pubsub → driver stdout)
+    # ------------------------------------------------------------------
+    async def _log_monitor_loop(self) -> None:
+        log_dir = os.path.join(self.session_dir, "logs")
+        offsets: Dict[str, int] = {}
+        partial: Dict[str, bytes] = {}
+        while not self._shutting_down:
+            await asyncio.sleep(0.5)
+            try:
+                names = sorted(os.listdir(log_dir)) if os.path.isdir(
+                    log_dir) else []
+                batches = []
+                for name in names:
+                    if not name.endswith(".log"):
+                        continue
+                    path = os.path.join(log_dir, name)
+                    try:
+                        size = os.path.getsize(path)
+                    except OSError:
+                        continue
+                    pos = offsets.get(name, 0)
+                    if size <= pos:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(pos)
+                        chunk = partial.pop(name, b"") + f.read(
+                            min(size - pos, 512 * 1024))
+                        offsets[name] = f.tell()
+                    *lines, rest = chunk.split(b"\n")
+                    if rest:
+                        partial[name] = rest
+                    lines = [ln.decode("utf-8", "replace") for ln in lines
+                             if ln.strip()]
+                    # Ship everything read (offsets already advanced past
+                    # it) — in capped batches, never by dropping.
+                    for j in range(0, len(lines), 200):
+                        batches.append({
+                            "source": name[:-len(".log")],
+                            "node": self.node_name,
+                            "lines": lines[j:j + 200],
+                        })
+                if batches and self._gcs is not None:
+                    await self._gcs.notify(
+                        "publish", channel="logs", message=batches)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # log shipping must never hurt the node
 
     # ------------------------------------------------------------------
     # Worker pool (reference: worker_pool.h:283)

@@ -30,3 +30,8 @@ __all__ = [
     "read_csv",
     "read_parquet",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rec
+
+_rec("data")
+del _rec

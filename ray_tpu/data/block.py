@@ -48,8 +48,25 @@ def block_from_items(items: Sequence[Any]) -> Block:
         for it in items:
             for k, v in it.items():
                 cols.setdefault(k, []).append(v)
-        return {k: np.asarray(v) for k, v in cols.items()}
-    return {VALUE_COL: np.asarray(items)}
+        return {k: _column_array(v) for k, v in cols.items()}
+    return {VALUE_COL: _column_array(list(items))}
+
+
+def _column_array(values: List[Any], force_object: bool = False
+                  ) -> np.ndarray:
+    """Column → ndarray; ragged values (e.g. variable-length token lists)
+    become a 1-D object array instead of failing. force_object=True skips
+    the dense attempt — callers with per-row sequences that MAY be
+    equal-length (e.g. generated token lists) need a stable 1-D object
+    column, not a shape that flips to 2-D when lengths happen to match."""
+    if not force_object:
+        try:
+            return np.asarray(values)
+        except ValueError:
+            pass
+    arr = np.empty(len(values), dtype=object)
+    arr[:] = values
+    return arr
 
 
 def block_to_items(block: Block) -> List[Any]:

@@ -73,6 +73,26 @@ class _MapBatches:
     fn_kwargs: Optional[Dict[str, Any]] = None
 
 
+@dataclasses.dataclass
+class _MapBatchesActor:
+    """Stateful transform: a pool of actors each holding one instance of
+    `cls` (reference: ActorPoolMapOperator,
+    _internal/execution/operators/actor_pool_map_operator.py). The expensive
+    constructor (model load, engine init) runs once per actor, not per
+    block."""
+
+    cls: type
+    batch_size: Optional[int]
+    concurrency: int = 1
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    window_per_actor: int = 2
+    name: str = "MapBatches(actors)"
+    fn_constructor_args: tuple = ()
+    fn_constructor_kwargs: Optional[Dict[str, Any]] = None
+    fn_kwargs: Optional[Dict[str, Any]] = None
+
+
 def _apply_map_batches(op: _MapBatches, block: Block) -> Block:
     outs = []
     kwargs = op.fn_kwargs or {}
@@ -93,7 +113,10 @@ def _exec_stream(plan: List[Any]) -> Iterator[Any]:
         stream = (ray_tpu.put(b) for b in src.make_blocks())
 
     for op in plan[1:]:
-        stream = _map_stream(op, stream)
+        if isinstance(op, _MapBatchesActor):
+            stream = _actor_map_stream(op, stream)
+        else:
+            stream = _map_stream(op, stream)
     return stream
 
 
@@ -114,6 +137,63 @@ def _map_stream(op: _MapBatches, upstream: Iterator[Any]) -> Iterator[Any]:
         yield inflight.popleft()
 
 
+def _actor_map_stream(op: _MapBatchesActor,
+                      upstream: Iterator[Any]) -> Iterator[Any]:
+    """Round-robin blocks over a pool of stateful actors, bounded in-flight
+    per actor, yielding results in input order. Actors are torn down when the
+    stream is exhausted (or abandoned)."""
+    from collections import deque
+
+    cls, batch_size, fn_kwargs = op.cls, op.batch_size, op.fn_kwargs or {}
+    ctor_args = op.fn_constructor_args
+    ctor_kwargs = op.fn_constructor_kwargs or {}
+
+    @ray_tpu.remote
+    class _BatchWorker:
+        def __init__(self):
+            self.inst = cls(*ctor_args, **ctor_kwargs)
+
+        def run(self, block: Block) -> Block:
+            outs = []
+            for batch in iter_block_batches(block, batch_size):
+                outs.append(normalize_batch_output(
+                    self.inst(batch, **fn_kwargs)))
+            return block_concat(outs) if outs else {}
+
+    actor_cls = _BatchWorker.options(
+        num_cpus=op.num_cpus, num_tpus=op.num_tpus)
+    pool = [actor_cls.remote() for _ in _range(max(1, op.concurrency))]
+    inflight: "deque[Any]" = deque()
+    all_refs: List[Any] = []
+    limit = max(1, op.window_per_actor) * len(pool)
+    completed = False
+    try:
+        for i, ref in enumerate(upstream):
+            out = pool[i % len(pool)].run.remote(ref)
+            all_refs.append(out)
+            inflight.append(out)
+            if len(inflight) >= limit:
+                yield inflight.popleft()
+        while inflight:
+            yield inflight.popleft()
+        completed = True
+    finally:
+        if completed and all_refs:
+            # Normal exhaustion: a downstream stage may still be consuming
+            # the tail refs — don't kill the pool under running tasks.
+            # (Abandoned stream: kill immediately; orphaned refs are never
+            # consumed.) wait() is metadata-only, no payload pull.
+            try:
+                ray_tpu.wait(all_refs, num_returns=len(all_refs), timeout=120)
+            except Exception:
+                pass
+        for a in pool:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
 class Dataset:
     """Lazy dataset of columnar blocks (reference: data/dataset.py:160)."""
 
@@ -122,8 +202,21 @@ class Dataset:
 
     # -- transforms (lazy) ------------------------------------------------
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
-                    num_cpus: float = 1.0, concurrency: int = DEFAULT_WINDOW,
+                    num_cpus: float = 1.0, num_tpus: float = 0.0,
+                    concurrency: int = DEFAULT_WINDOW,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[Dict[str, Any]] = None,
                     fn_kwargs: Optional[Dict[str, Any]] = None) -> "Dataset":
+        """Function transforms run as tasks; a callable CLASS runs on a pool
+        of `concurrency` stateful actors, constructed once each (reference:
+        TaskPoolMapOperator vs ActorPoolMapOperator)."""
+        if isinstance(fn, type):
+            return Dataset(self._plan + [_MapBatchesActor(
+                fn, batch_size, concurrency=concurrency, num_cpus=num_cpus,
+                num_tpus=num_tpus, name=f"MapBatches({fn.__name__})",
+                fn_constructor_args=fn_constructor_args,
+                fn_constructor_kwargs=fn_constructor_kwargs,
+                fn_kwargs=fn_kwargs)])
         return Dataset(self._plan + [_MapBatches(
             fn, batch_size, num_cpus, concurrency,
             name=getattr(fn, "__name__", "map_batches"), fn_kwargs=fn_kwargs)])

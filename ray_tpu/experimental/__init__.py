@@ -1,0 +1,14 @@
+"""Experimental APIs: the device-object plane (HBM-resident transfer).
+
+Counterpart of python/ray/experimental/ in the reference (RDT / GPU objects:
+gpu_object_manager/gpu_object_manager.py:54). See device_objects.py.
+"""
+
+from ray_tpu.experimental import device_objects  # noqa: F401
+from ray_tpu.experimental.internal_kv import (  # noqa: F401
+    _internal_kv_del,
+    _internal_kv_exists,
+    _internal_kv_get,
+    _internal_kv_list,
+    _internal_kv_put,
+)

@@ -1,0 +1,358 @@
+"""Device-object plane: jax.Arrays stay in HBM and move process-to-process
+without a pickle round trip.
+
+TPU-native counterpart of the reference's Ray Direct Transport / GPU objects
+(python/ray/experimental/gpu_object_manager/gpu_object_manager.py:54,
+gpu_object_store.py). On TPU, avoiding host⇄HBM staging matters more than on
+GPU: every normal object-plane hop costs a device→host copy at serialization
+(serialization.py jax handling) plus a host→device copy on use.
+
+Design (pull-based, no driver coordination — unlike the reference, which has
+the caller orchestrate send/recv pairs through a collective group, we let the
+*receiver* resolve tensors on first use; there is no global metadata owner):
+
+- Each worker process has a ``DeviceObjectStore``: object_id → list of
+  jax.Array, living on that process's local device(s).
+- ``device_put(value)`` extracts every jax.Array from ``value`` (arbitrary
+  pytree/containers), stores them locally, and puts a small
+  ``DeviceObjectValue`` skeleton through the normal object plane. The
+  skeleton records (src RPC address, object id, per-tensor shape/dtype).
+- Actor methods opt in with ``.options(tensor_transport="device")``: their
+  return value goes through the same extraction on the *executing* actor, so
+  results never leave HBM unless some other process asks for them.
+- When any process deserializes the skeleton (``ray.get`` or a task arg),
+  resolution kicks in:
+    * same process → the original jax.Array objects, zero copies;
+    * other process → one ``device_object_fetch`` RPC to the source worker;
+      buffers travel device→host→(shm/socket, zero-copy pickle-5)→device.
+      This is the host-staging transport — the only possible one between two
+      single-host processes that own disjoint TPU chips.
+- Multi-host SPMD note: between hosts of one jax.distributed mesh, arrays are
+  *already* resident where the computation needs them, and movement compiles
+  into the program as ICI collectives (parallel/). The device-object plane is
+  for MPMD actor topologies (pipelines, serve replicas), where host staging
+  over DCN matches what the hardware offers. ``Communicator`` below is the
+  plugin surface for future out-of-band transports.
+
+Garbage collection: the object's owner (the caller, for actor-method results;
+the putting process, for device_put) already ref-counts the skeleton. When
+the owner's count hits zero, Worker._on_owned_ref_zero calls
+``on_owner_ref_zero`` here, which drops the local entry and/or sends one
+fire-and-forget ``device_object_free`` to the source actor.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import pickle
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+def _is_jax_array(value: Any) -> bool:
+    mod = type(value).__module__
+    return mod is not None and mod.startswith("jax")
+
+
+@dataclass
+class _TensorMeta:
+    shape: Tuple[int, ...]
+    dtype: str  # numpy dtype string
+    sharding: str = ""  # informational (repr of the source sharding)
+
+
+class _DeviceTensorRef:
+    """Placeholder standing in for one extracted jax.Array inside the
+    skeleton. Pickles as its index."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index: int):
+        self.index = index
+
+    def __reduce__(self):
+        return (_DeviceTensorRef, (self.index,))
+
+
+@dataclass
+class DeviceObjectValue:
+    """What actually travels through the normal object plane: a pickled
+    skeleton with _DeviceTensorRef placeholders + source coordinates."""
+
+    skeleton: bytes  # cloudpickle of the structure with placeholders
+    meta: List[_TensorMeta]
+    src_address: Tuple[str, int]  # RPC address of the worker holding tensors
+    object_id: bytes  # binary ObjectID the tensors are stored under
+
+
+@dataclass
+class _Entry:
+    arrays: List[Any]
+    meta: List[_TensorMeta]
+
+
+class DeviceObjectStore:
+    """Per-process HBM-resident object table."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[bytes, _Entry] = {}
+
+    def add(self, object_id: bytes, arrays: List[Any],
+            meta: List[_TensorMeta]) -> None:
+        with self._lock:
+            self._entries[object_id] = _Entry(arrays, meta)
+
+    def get(self, object_id: bytes) -> Optional[_Entry]:
+        with self._lock:
+            return self._entries.get(object_id)
+
+    def drop(self, object_id: bytes) -> bool:
+        with self._lock:
+            return self._entries.pop(object_id, None) is not None
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class Communicator(abc.ABC):
+    """Transport plugin surface (reference:
+    experimental/channel/communicator.py:18). The default, and on single-host
+    TPU topologies the only physically possible one, is host staging; an ICI
+    communicator for jax.distributed meshes would implement send/recv as
+    compiled ppermute steps."""
+
+    @abc.abstractmethod
+    def fetch(self, worker, value: "DeviceObjectValue") -> List[Any]:
+        """Return the tensors of `value` materialized on the local device."""
+
+
+class HostStagingCommunicator(Communicator):
+    """Device→host→(zero-copy wire)→device via one RPC to the source."""
+
+    def fetch(self, worker, value: "DeviceObjectValue") -> List[Any]:
+        return worker.loop_thread.run(
+            _fetch_async(worker, value))
+
+
+_communicator: Communicator = HostStagingCommunicator()
+
+
+def set_communicator(comm: Communicator) -> None:
+    global _communicator
+    _communicator = comm
+
+
+# ----------------------------------------------------------------------
+# Extraction (source side)
+# ----------------------------------------------------------------------
+
+def extract(value: Any) -> Tuple[bytes, List[Any], List[_TensorMeta]]:
+    """Replace every jax.Array in `value` with a placeholder; return
+    (pickled skeleton, arrays, meta). Uses a custom pickler so arbitrary
+    containers work, not just registered pytrees."""
+    import cloudpickle
+
+    arrays: List[Any] = []
+    meta: List[_TensorMeta] = []
+
+    import io
+
+    class _ExtractPickler(cloudpickle.Pickler):
+        def persistent_id(self, obj):
+            if _is_jax_array(obj) and hasattr(obj, "shape"):
+                idx = len(arrays)
+                arrays.append(obj)
+                import numpy as np
+
+                meta.append(_TensorMeta(
+                    tuple(obj.shape), str(np.dtype(obj.dtype)),
+                    repr(getattr(obj, "sharding", ""))))
+                return ("device_tensor", idx)
+            return None
+
+    buf = io.BytesIO()
+    _ExtractPickler(buf, protocol=5).dump(value)
+    return buf.getvalue(), arrays, meta
+
+
+def _rebuild(skeleton: bytes, arrays: List[Any]) -> Any:
+    import io
+
+    class _RebuildUnpickler(pickle.Unpickler):
+        def persistent_load(self, pid):
+            tag, idx = pid
+            if tag == "device_tensor":
+                return arrays[idx]
+            raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+
+    return _RebuildUnpickler(io.BytesIO(skeleton)).load()
+
+
+def store_result(worker, object_id, value: Any) -> DeviceObjectValue:
+    """Executor side of tensor_transport="device": extract `value`'s arrays
+    into this process's store under `object_id`, return the skeleton."""
+    skeleton, arrays, meta = extract(value)
+    worker.device_object_store.add(object_id.binary(), arrays, meta)
+    return DeviceObjectValue(
+        skeleton=skeleton, meta=meta, src_address=tuple(worker.address),
+        object_id=object_id.binary())
+
+
+# ----------------------------------------------------------------------
+# Public API
+# ----------------------------------------------------------------------
+
+def device_put(value: Any):
+    """Like ray.put, but jax.Arrays inside `value` stay on this process's
+    device; consumers receive them on *their* device without the value ever
+    being pickled through host memory as a whole."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    skeleton, arrays, meta = extract(value)
+    object_id = w.allocate_put_id()
+    w.device_object_store.add(object_id.binary(), arrays, meta)
+    return w.put_with_id(object_id, DeviceObjectValue(
+        skeleton=skeleton, meta=meta, src_address=tuple(w.address),
+        object_id=object_id.binary()))
+
+
+def local_store_size() -> int:
+    from ray_tpu._private import worker as worker_mod
+
+    return len(worker_mod.global_worker().device_object_store)
+
+
+# ----------------------------------------------------------------------
+# Resolution (consumer side)
+# ----------------------------------------------------------------------
+
+def resolve_sync(worker, value: Any) -> Any:
+    """If `value` is a device-object skeleton, materialize its tensors
+    locally (same-process: the original arrays; remote: one fetch RPC).
+    Runs on a non-loop thread."""
+    if not isinstance(value, DeviceObjectValue):
+        return value
+    entry = worker.device_object_store.get(value.object_id)
+    if entry is not None:
+        return _rebuild(value.skeleton, entry.arrays)
+    arrays = _communicator.fetch(worker, value)
+    return _rebuild(value.skeleton, arrays)
+
+
+async def resolve_async(worker, value: Any) -> Any:
+    """Loop-side variant of resolve_sync."""
+    if not isinstance(value, DeviceObjectValue):
+        return value
+    entry = worker.device_object_store.get(value.object_id)
+    if entry is not None:
+        return _rebuild(value.skeleton, entry.arrays)
+    arrays = await _fetch_async(worker, value)
+    return _rebuild(value.skeleton, arrays)
+
+
+async def _fetch_async(worker, value: DeviceObjectValue) -> List[Any]:
+    import numpy as np
+
+    from ray_tpu._private.rpc import RpcClient
+
+    client = RpcClient(*value.src_address, name="device-fetch")
+    try:
+        reply = await client.call(
+            "device_object_fetch", object_id=value.object_id)
+    finally:
+        try:
+            await client.close()
+        except Exception:
+            pass
+    if reply.get("error"):
+        from ray_tpu.exceptions import ObjectLostError
+
+        raise ObjectLostError(
+            f"device object {value.object_id.hex()[:12]} no longer on "
+            f"source {value.src_address}: {reply['error']}")
+    bufs = reply["buffers"]
+    out = []
+    for m, buf in zip(value.meta, bufs):
+        host = np.frombuffer(buf, dtype=np.dtype(m.dtype)).reshape(m.shape)
+        out.append(_to_local_device(host))
+    return out
+
+
+def _to_local_device(host_array) -> Any:
+    import jax
+
+    return jax.device_put(host_array)
+
+
+# ----------------------------------------------------------------------
+# Worker hooks (called from _private/worker.py)
+# ----------------------------------------------------------------------
+
+async def rpc_fetch(worker, object_id: bytes) -> Dict[str, Any]:
+    """Source side: ship tensors as raw host buffers (zero-copy on the
+    wire via the RPC layer's pickle-5 buffer_callback). The device→host
+    copy runs off the event loop — a multi-GB DMA must not stall the
+    source actor's RPC handling."""
+    entry = worker.device_object_store.get(object_id)
+    if entry is None:
+        return {"error": "not found"}
+    import asyncio
+
+    import numpy as np
+
+    def _stage():
+        bufs = []
+        for a in entry.arrays:
+            host = np.asarray(a)  # device→host; no-op for CPU jax
+            if not host.flags.c_contiguous:
+                host = np.ascontiguousarray(host)
+            bufs.append(pickle.PickleBuffer(host))
+        return bufs
+
+    loop = asyncio.get_running_loop()
+    return {"buffers": await loop.run_in_executor(None, _stage)}
+
+
+async def rpc_free(worker, object_id: bytes) -> Dict[str, Any]:
+    worker.device_object_store.drop(object_id)
+    return {"ok": True}
+
+
+def on_owner_ref_zero(worker, object_id) -> None:
+    """Owner-side GC hook: drop local tensors; tell a remote source to drop
+    theirs (fire-and-forget — source crash just orphans nothing, its store
+    dies with the process)."""
+    binary = object_id.binary()
+    worker.device_object_store.drop(binary)
+    src = worker.device_object_srcs.pop(binary, None)
+    if src is None or tuple(src) == tuple(worker.address):
+        return
+
+    async def _free():
+        from ray_tpu._private.rpc import RpcClient
+
+        client = None
+        try:
+            client = RpcClient(*src, name="device-free")
+            await client.notify("device_object_free", object_id=binary)
+        except Exception:
+            pass
+        finally:
+            if client is not None:
+                try:
+                    await client.close()
+                except Exception:
+                    pass
+
+    try:
+        worker.loop.call_soon_threadsafe(
+            lambda: worker.loop.create_task(_free()))
+    except Exception:
+        pass

@@ -1,0 +1,51 @@
+"""Public face of the GCS internal key-value store.
+
+Counterpart of python/ray/experimental/internal_kv.py in the reference
+(backed by gcs_kv_manager.h / store_client_kv.h there; by core/gcs.py
+rpc_kv_* here). Used by libraries that need tiny cluster-global metadata
+without standing up an actor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+_NAMESPACE = "ikv:"
+
+
+def _call(method: str, **kwargs):
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker()._gcs_call_sync(method, **kwargs)
+
+
+def _internal_kv_put(key: bytes, value: bytes, overwrite: bool = True) -> bool:
+    """Returns True if the key already existed (matching the reference's
+    return convention)."""
+    key_s = _NAMESPACE + (key.decode() if isinstance(key, bytes) else key)
+    existed = _call("kv_get", key=key_s) is not None
+    if existed and not overwrite:
+        return True
+    _call("kv_put", key=key_s, value=value, overwrite=True)
+    return existed
+
+
+def _internal_kv_get(key: bytes) -> Optional[bytes]:
+    key_s = _NAMESPACE + (key.decode() if isinstance(key, bytes) else key)
+    return _call("kv_get", key=key_s)
+
+
+def _internal_kv_exists(key: bytes) -> bool:
+    return _internal_kv_get(key) is not None
+
+
+def _internal_kv_del(key: bytes) -> None:
+    key_s = _NAMESPACE + (key.decode() if isinstance(key, bytes) else key)
+    _call("kv_del", key=key_s)
+
+
+def _internal_kv_list(prefix: bytes) -> List[bytes]:
+    prefix_s = _NAMESPACE + (
+        prefix.decode() if isinstance(prefix, bytes) else prefix)
+    keys = _call("kv_keys", prefix=prefix_s)
+    return [k[len(_NAMESPACE):].encode() for k in keys]

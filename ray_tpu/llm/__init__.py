@@ -7,6 +7,11 @@ deployed on ray_tpu.serve replicas."""
 
 from typing import Any, Dict, Optional
 
+from ray_tpu.llm._internal.batch import (
+    Processor,
+    ProcessorConfig,
+    build_llm_processor,
+)
 from ray_tpu.llm._internal.engine import EngineConfig, LLMEngine, Request
 from ray_tpu.llm._internal.paged import (
     PagedCacheConfig,
@@ -40,9 +45,17 @@ __all__ = [
     "LLMEngine",
     "LLMServer",
     "PagedCacheConfig",
+    "Processor",
+    "ProcessorConfig",
     "Request",
     "build_llm_deployment",
+    "build_llm_processor",
     "paged_attention",
     "paged_gather",
     "paged_write",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rec
+
+_rec("llm")
+del _rec

@@ -1,0 +1,123 @@
+"""Batch LLM inference on Data (reference: python/ray/llm/_internal/batch/
+processor/base.py Processor/ProcessorBuilder + stages/; there each stage wraps
+vLLM/SGLang engines — here the engine stage hosts ray_tpu's own
+continuous-batching engine on a pool of Data actors).
+
+Shape: preprocess (stateless map) → engine stage (stateful actor pool, one
+engine per actor, continuous batching WITHIN each block) → postprocess.
+
+Input rows carry token ids in `prompt_ids` (a list/array per row). Output
+rows gain `generated_ids` and `num_generated`. Tokenization is the caller's
+preprocess job — the framework is tokenizer-agnostic, like the reference's
+`apply_chat_template`-optional path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ProcessorConfig:
+    """Reference: batch/processor/base.py ProcessorConfig (pydantic there;
+    a plain dataclass here — the config surface is the parity point)."""
+
+    llm_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    batch_size: int = 32
+    concurrency: int = 1  # engine-stage actor pool size
+    num_tpus: float = 0.0  # per engine actor
+    max_tokens: int = 32  # default generation budget per row
+    temperature: float = 0.0
+    stop_token: Optional[int] = None
+
+
+class _EngineStage:
+    """Callable class run on Data's actor pool: one engine per actor."""
+
+    def __init__(self, cfg: ProcessorConfig):
+        from ray_tpu.llm._internal.engine import EngineConfig, LLMEngine
+        from ray_tpu.llm._internal.server import load_model_and_params
+
+        self.cfg = cfg
+        model, params = load_model_and_params(cfg.llm_config)
+        eng_cfg = EngineConfig(
+            **(cfg.llm_config.get("engine_config") or {}))
+        self.engine = LLMEngine(model, params, eng_cfg)
+
+    def __call__(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        from ray_tpu.llm._internal.engine import Request
+
+        prompts = batch["prompt_ids"]
+        n = len(prompts)
+        max_tokens = batch.get("max_tokens")
+        outputs: Dict[str, list] = {i: [] for i in range(len(prompts))}
+        pending = [
+            Request(
+                request_id=str(i),
+                prompt_ids=[int(t) for t in prompts[i]],
+                max_tokens=int(max_tokens[i]) if max_tokens is not None
+                else self.cfg.max_tokens,
+                temperature=self.cfg.temperature,
+                stop_token=self.cfg.stop_token,
+            )
+            for i in range(n)
+        ]
+        # Continuous batching within the block: the engine admits from its
+        # waiting queue as slots free up; collect until every row finishes.
+        for req in pending:
+            self.engine.add_request(req)
+        done = 0
+        while done < n:
+            for out in self.engine.step():
+                i = int(out.request_id)
+                outputs[i].append(out.token)
+                if out.finished:
+                    done += 1
+        out_batch = dict(batch)
+        from ray_tpu.data.block import _column_array
+
+        # force_object: a batch where every row generated the same length
+        # must STILL be a 1-D object column — a dense (n, k) column would
+        # fail to concat with a ragged block downstream.
+        out_batch["generated_ids"] = _column_array(
+            [np.array(outputs[i], np.int32) for i in range(n)],
+            force_object=True)
+        out_batch["num_generated"] = np.array(
+            [len(outputs[i]) for i in range(n)], np.int64)
+        return out_batch
+
+
+class Processor:
+    """ds → ds pipeline (reference: batch/processor/base.py Processor)."""
+
+    def __init__(self, config: ProcessorConfig,
+                 preprocess: Optional[Callable] = None,
+                 postprocess: Optional[Callable] = None):
+        self.config = config
+        self.preprocess = preprocess
+        self.postprocess = postprocess
+
+    def __call__(self, ds):
+        cfg = self.config
+        if self.preprocess is not None:
+            ds = ds.map(self.preprocess)
+        ds = ds.map_batches(
+            _EngineStage,
+            batch_size=cfg.batch_size,
+            concurrency=cfg.concurrency,
+            num_tpus=cfg.num_tpus,
+            fn_constructor_args=(cfg,),
+        )
+        if self.postprocess is not None:
+            ds = ds.map(self.postprocess)
+        return ds
+
+
+def build_llm_processor(config: ProcessorConfig,
+                        preprocess: Optional[Callable] = None,
+                        postprocess: Optional[Callable] = None) -> Processor:
+    """Reference: ProcessorBuilder.build / build_llm_processor."""
+    return Processor(config, preprocess, postprocess)

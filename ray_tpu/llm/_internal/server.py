@@ -20,33 +20,39 @@ from ray_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def load_model_and_params(llm_config: Dict[str, Any]):
+    """Resolve an llm_config dict to (model, params). Shared by the serve
+    path (LLMServer) and the batch path (_internal/batch.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+    model_cfg = llm_config.get("model_config") or {}
+    preset = llm_config.get("model", "tiny")
+    if preset == "tiny":
+        cfg = LlamaConfig.tiny(**model_cfg)
+    elif preset == "llama3-8b":
+        cfg = LlamaConfig.llama3_8b()
+    else:
+        cfg = LlamaConfig(**model_cfg)
+    model = LlamaModel(cfg)
+    params_path = llm_config.get("params_path")
+    if params_path:
+        import pickle
+
+        with open(params_path, "rb") as f:
+            params = pickle.load(f)
+    else:
+        seed = int(llm_config.get("seed", 0))
+        sample = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(seed), sample)["params"]
+    return model, params
+
+
 class LLMServer:
     def __init__(self, llm_config: Dict[str, Any]):
-        import jax
-        import jax.numpy as jnp
-
-        from ray_tpu.models.llama import LlamaConfig, LlamaModel
-
-        model_cfg = llm_config.get("model_config") or {}
-        preset = llm_config.get("model", "tiny")
-        if preset == "tiny":
-            cfg = LlamaConfig.tiny(**model_cfg)
-        elif preset == "llama3-8b":
-            cfg = LlamaConfig.llama3_8b()
-        else:
-            cfg = LlamaConfig(**model_cfg)
-        self.model = LlamaModel(cfg)
-        params_path = llm_config.get("params_path")
-        if params_path:
-            import pickle
-
-            with open(params_path, "rb") as f:
-                self.params = pickle.load(f)
-        else:
-            seed = int(llm_config.get("seed", 0))
-            sample = jnp.zeros((1, 8), jnp.int32)
-            self.params = self.model.init(
-                jax.random.PRNGKey(seed), sample)["params"]
+        self.model, self.params = load_model_and_params(llm_config)
         eng_cfg = EngineConfig(**(llm_config.get("engine_config") or {}))
         self.engine = LLMEngine(self.model, self.params, eng_cfg)
         self._queues: Dict[str, "queue.Queue"] = {}

@@ -25,6 +25,14 @@ class RemoteFunction:
         return RemoteFunction(self._fn, **merged)
 
     def remote(self, *args, **kwargs):
+        from ray_tpu import api
+
+        if api._global_client is not None:
+            # Client mode entered after decoration (the common pattern:
+            # decorate at module top, init("ray://…") in main) — route
+            # through the proxy at call time.
+            return api._global_client.remote(
+                self._fn, **self._options).remote(*args, **kwargs)
         w = worker_mod.global_worker()
         opts = self._options
         resources: Dict[str, float] = dict(opts.get("resources") or {})

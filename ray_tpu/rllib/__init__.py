@@ -4,6 +4,14 @@ PPO with a flax RLModule, EnvRunnerGroup of sampling actors, and a
 LearnerGroup running jitted PPO updates (see ppo.py, learner.py,
 env_runner.py, rl_module.py)."""
 
+from ray_tpu.rllib.dqn import (
+    DQN,
+    DQNConfig,
+    DQNLearner,
+    DQNLearnerConfig,
+    DQNModule,
+    ReplayBuffer,
+)
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.learner import (
     LearnerGroup,
@@ -15,7 +23,13 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig
 from ray_tpu.rllib.rl_module import RLModule
 
 __all__ = [
+    "DQN",
+    "DQNConfig",
+    "DQNLearner",
+    "DQNLearnerConfig",
+    "DQNModule",
     "EnvRunnerGroup",
+    "ReplayBuffer",
     "LearnerGroup",
     "PPO",
     "PPOConfig",
@@ -25,3 +39,8 @@ __all__ = [
     "SingleAgentEnvRunner",
     "compute_gae",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rec
+
+_rec("rllib")
+del _rec

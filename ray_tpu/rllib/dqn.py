@@ -1,0 +1,352 @@
+"""DQN (reference: rllib/algorithms/dqn/ — new API stack shape: RLModule +
+Learner + EnvRunnerGroup + replay buffer; double-DQN target, target network,
+epsilon-greedy exploration with linear annealing).
+
+TPU-first notes: the gradient step is one jitted function over fixed-size
+minibatches drawn from a host-side circular replay buffer (replay lives in
+host RAM — it is random-access IO, not FLOPs); the target-network refresh is
+a pure tree copy inside the same jit boundary when due."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+
+class QNet(nn.Module):
+    num_actions: int
+    hidden: Sequence[int] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_actions)(x)
+
+
+class DQNModule:
+    """Q-network module, interface-compatible with SingleAgentEnvRunner:
+    forward_inference(weights, obs, key) -> (action, logp, value). Weights
+    travel as a bundle {"params", "epsilon"} so exploration anneals through
+    the same sync_weights path as the parameters."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        import jax
+        import jax.numpy as jnp
+
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.net = QNet(num_actions, tuple(hidden))
+
+        def act(params, epsilon, obs, key):
+            q = self.net.apply({"params": params}, obs)
+            greedy = jnp.argmax(q, axis=-1)
+            k1, k2 = jax.random.split(key)
+            rand = jax.random.randint(
+                k1, greedy.shape, 0, self.num_actions)
+            explore = jax.random.uniform(k2, greedy.shape) < epsilon
+            action = jnp.where(explore, rand, greedy)
+            zeros = jnp.zeros(greedy.shape, jnp.float32)
+            return action, zeros, zeros
+
+        self._act = jax.jit(act)
+
+    def init_params(self, rng):
+        import jax.numpy as jnp
+
+        return self.net.init(rng, jnp.zeros((1, self.obs_dim)))["params"]
+
+    def forward_inference(self, weights, obs: np.ndarray, key):
+        import jax.numpy as jnp
+
+        a, logp, v = self._act(weights["params"],
+                               jnp.float32(weights.get("epsilon", 0.0)),
+                               jnp.asarray(obs), key)
+        return np.asarray(a), np.asarray(logp), np.asarray(v)
+
+    def __getstate__(self) -> Dict[str, Any]:
+        return {"obs_dim": self.obs_dim, "num_actions": self.num_actions,
+                "hidden": tuple(self.net.hidden)}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__init__(**state)
+
+
+class ReplayBuffer:
+    """Uniform circular replay (reference:
+    rllib/utils/replay_buffers/replay_buffer.py, trimmed to the DQN need)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.empty((capacity, obs_dim), np.float32)
+        self.next_obs = np.empty((capacity, obs_dim), np.float32)
+        self.actions = np.empty((capacity,), np.int32)
+        self.rewards = np.empty((capacity,), np.float32)
+        self.dones = np.empty((capacity,), np.float32)
+        self.size = 0
+        self._idx = 0
+
+    def add_batch(self, obs, actions, rewards, next_obs, dones) -> None:
+        for i in range(obs.shape[0]):
+            j = self._idx
+            self.obs[j] = obs[i]
+            self.next_obs[j] = next_obs[i]
+            self.actions[j] = actions[i]
+            self.rewards[j] = rewards[i]
+            self.dones[j] = dones[i]
+            self._idx = (j + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, n: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, size=n)
+        return {
+            "obs": self.obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "next_obs": self.next_obs[idx],
+            "dones": self.dones[idx],
+        }
+
+
+@dataclasses.dataclass
+class DQNLearnerConfig:
+    lr: float = 1e-3
+    gamma: float = 0.99
+    batch_size: int = 128
+    sgd_steps_per_iter: int = 32
+    target_update_period: int = 256  # in sgd steps
+    double_dqn: bool = True
+    max_grad_norm: float = 10.0
+
+
+class DQNLearner:
+    """Owns online + target params; one jitted TD step."""
+
+    def __init__(self, module: DQNModule, config: DQNLearnerConfig,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.module = module
+        self.cfg = config
+        self.opt = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(config.lr))
+        self.params = module.init_params(jax.random.PRNGKey(seed))
+        # Real copies: the online params are donated into the jitted step, so
+        # the target must never alias their buffers.
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt_state = self.opt.init(self.params)
+        self._steps = 0
+        net = module.net
+        cfg = config
+
+        def loss_fn(params, target_params, mb):
+            q = net.apply({"params": params}, mb["obs"])
+            q_sel = jnp.take_along_axis(
+                q, mb["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+            q_next_t = net.apply({"params": target_params}, mb["next_obs"])
+            if cfg.double_dqn:
+                q_next_o = net.apply({"params": params}, mb["next_obs"])
+                best = jnp.argmax(q_next_o, axis=-1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, best[:, None], axis=1)[:, 0]
+            else:
+                q_next = jnp.max(q_next_t, axis=-1)
+            target = mb["rewards"] + cfg.gamma * (1.0 - mb["dones"]) * \
+                jax.lax.stop_gradient(q_next)
+            return optax.huber_loss(q_sel, target).mean()
+
+        def step(params, target_params, opt_state, mb):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target_params, mb)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._step = jax.jit(step, donate_argnums=(0, 2))
+
+    def update(self, minibatches: List[Dict[str, np.ndarray]]
+               ) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        losses = []
+        for mb in minibatches:
+            mb = {k: jnp.asarray(v) for k, v in mb.items()}
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.target_params, self.opt_state, mb)
+            losses.append(float(loss))
+            self._steps += 1
+            if self._steps % self.cfg.target_update_period == 0:
+                self.target_params = jax.tree.map(jnp.copy, self.params)
+        return {"loss": float(np.mean(losses)), "sgd_steps": len(losses)}
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+
+class DQNConfig:
+    """Builder-style config (reference: DQNConfig fluent API)."""
+
+    def __init__(self):
+        self._env_fn: Optional[Callable] = None
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_length = 32
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.buffer_capacity = 50_000
+        self.learn_start = 500  # transitions before SGD begins
+        self.epsilon = (1.0, 0.05)  # (initial, final)
+        self.epsilon_anneal_steps = 5_000  # env steps
+        self.learner = DQNLearnerConfig()
+
+    def environment(self, env: Any = None, *,
+                    env_fn: Optional[Callable] = None) -> "DQNConfig":
+        if env_fn is not None:
+            self._env_fn = env_fn
+        elif isinstance(env, str):
+            name = env
+
+            def make():
+                import gymnasium
+
+                return gymnasium.make(name)
+
+            self._env_fn = make
+        else:
+            self._env_fn = env
+        return self
+
+    def env_runners(self, *, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 4,
+                    rollout_fragment_length: int = 32) -> "DQNConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_length = rollout_fragment_length
+        return self
+
+    def training(self, **overrides) -> "DQNConfig":
+        for k, v in overrides.items():
+            if hasattr(self.learner, k):
+                setattr(self.learner, k, v)
+            elif k in ("buffer_capacity", "learn_start",
+                       "epsilon_anneal_steps"):
+                setattr(self, k, int(v))
+            elif k == "epsilon":
+                self.epsilon = tuple(v)
+            elif k == "model_hidden":
+                self.hidden = tuple(v)
+            else:
+                raise ValueError(f"unknown training option {k!r}")
+        return self
+
+    def debugging(self, *, seed: int = 0) -> "DQNConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN:
+    """training_step: sample with epsilon-greedy → replay add →
+    sgd_steps_per_iter TD steps → sync weights+epsilon (reference:
+    dqn.py training_step)."""
+
+    def __init__(self, config: DQNConfig):
+        assert config._env_fn is not None, "call .environment(...) first"
+        self.config = config
+        probe = config._env_fn()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        self.obs_dim = obs_dim
+        self.module = DQNModule(obs_dim, num_actions, config.hidden)
+        self.learner = DQNLearner(self.module, config.learner, config.seed)
+        self.buffer = ReplayBuffer(config.buffer_capacity, obs_dim)
+        self.env_runners = EnvRunnerGroup(
+            config._env_fn, self.module,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed)
+        self._rng = np.random.default_rng(config.seed)
+        self.env_steps = 0
+        self.iteration = 0
+        self._return_window: List[float] = []
+        self._sync()
+
+    def _epsilon(self) -> float:
+        e0, e1 = self.config.epsilon
+        frac = min(1.0, self.env_steps / max(1, self.config.epsilon_anneal_steps))
+        return float(e0 + (e1 - e0) * frac)
+
+    def _sync(self) -> None:
+        self.env_runners.sync_weights(
+            {"params": self.learner.get_weights(),
+             "epsilon": self._epsilon()})
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        rollouts = self.env_runners.sample(cfg.rollout_length)
+        for r in rollouts:
+            obs, act = r["obs"], r["actions"]  # [T, N, ...]
+            T = obs.shape[0]
+            # Transitions: next_obs[t] = obs[t+1]; the final step per env is
+            # dropped (its successor is outside the fragment). A done step's
+            # "next obs" is the post-reset obs, but dones mask the bootstrap
+            # so the value never enters the target.
+            flat = lambda x: x[:T - 1].reshape((-1,) + x.shape[2:])
+            self.buffer.add_batch(
+                flat(obs).reshape(-1, self.obs_dim),
+                flat(act).ravel(),
+                flat(r["rewards"]).ravel(),
+                obs[1:].reshape(-1, self.obs_dim),
+                flat(r["dones"]).ravel())
+            self.env_steps += T * obs.shape[1]
+        result = {"loss": float("nan"), "sgd_steps": 0}
+        if self.buffer.size >= max(cfg.learn_start, cfg.learner.batch_size):
+            mbs = [self.buffer.sample(cfg.learner.batch_size, self._rng)
+                   for _ in range(cfg.learner.sgd_steps_per_iter)]
+            result = self.learner.update(mbs)
+        self._sync()
+        self._return_window.extend(self.env_runners.episode_returns())
+        self._return_window = self._return_window[-100:]
+        dt = time.perf_counter() - t0
+        steps = cfg.rollout_length * cfg.num_envs_per_runner * \
+            cfg.num_env_runners
+        return {
+            "loss": result["loss"],
+            "sgd_steps": result["sgd_steps"],
+            "epsilon": self._epsilon(),
+            "env_steps_this_iter": steps,
+            "env_steps_total": self.env_steps,
+            "env_steps_per_s": steps / dt,
+            "episode_return_mean": (float(np.mean(self._return_window))
+                                    if self._return_window else float("nan")),
+        }
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        out = self.training_step()
+        out["training_iteration"] = self.iteration
+        return out
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self) -> None:
+        pass

@@ -19,6 +19,8 @@ from ray_tpu.serve._handle import (
     DeploymentResponse,
     DeploymentResponseGenerator,
 )
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -40,7 +42,8 @@ class Deployment:
                  max_ongoing_requests: int = 16,
                  user_config: Optional[Dict[str, Any]] = None,
                  route_prefix: Optional[str] = None,
-                 autoscaling_config: Optional[Dict[str, Any]] = None):
+                 autoscaling_config: Optional[Dict[str, Any]] = None,
+                 request_router: str = "pow2"):
         self._ctor = ctor
         self.name = name
         self.num_replicas = num_replicas
@@ -49,6 +52,7 @@ class Deployment:
         self.user_config = user_config
         self.route_prefix = route_prefix
         self.autoscaling_config = autoscaling_config
+        self.request_router = request_router
 
     def options(self, **overrides) -> "Deployment":
         cfg = dict(
@@ -56,7 +60,8 @@ class Deployment:
             ray_actor_options=self.ray_actor_options,
             max_ongoing_requests=self.max_ongoing_requests,
             user_config=self.user_config, route_prefix=self.route_prefix,
-            autoscaling_config=self.autoscaling_config)
+            autoscaling_config=self.autoscaling_config,
+            request_router=self.request_router)
         cfg.update(overrides)
         return Deployment(self._ctor, **cfg)
 
@@ -129,7 +134,8 @@ def run(target: Application, *, name: str = "default",
                  max_ongoing_requests=dep.max_ongoing_requests,
                  user_config=dep.user_config,
                  route_prefix=prefix,
-                 autoscaling_config=dep.autoscaling_config)), timeout=120)
+                 autoscaling_config=dep.autoscaling_config,
+                 request_router=dep.request_router)), timeout=120)
     handle = DeploymentHandle(apps[0][0].deployment.name)
     # Wait until the root deployment has live replicas (and release the
     # probe's outstanding slot so routing stays unbiased).
@@ -196,13 +202,21 @@ __all__ = [
     "DeploymentHandle",
     "DeploymentResponse",
     "DeploymentResponseGenerator",
+    "batch",
     "delete",
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "get_multiplexed_model_id",
     "http_port",
+    "multiplexed",
     "run",
     "shutdown",
     "start",
     "status",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rec
+
+_rec("serve")
+del _rec

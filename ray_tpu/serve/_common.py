@@ -24,6 +24,9 @@ class DeploymentConfig:
     # to [min_replicas, max_replicas]; scale-down requires several
     # consecutive low readings (cooldown).
     autoscaling_config: Optional[Dict[str, Any]] = None
+    # "pow2" (default) or "prefix" (LLM prompt-prefix affinity; reference:
+    # request_router/prefix_aware_router.py).
+    request_router: str = "pow2"
 
 
 @dataclasses.dataclass

@@ -94,6 +94,7 @@ class ServeController:
                         "route_prefix": d["config"].route_prefix,
                         "max_ongoing_requests":
                             d["config"].max_ongoing_requests,
+                        "request_router": d["config"].request_router,
                     }
                     for name, d in self._deployments.items()
                 },

@@ -25,6 +25,9 @@ class _RouterCache:
         self.deployments: Dict[str, Any] = {}
         self.fetched_at = 0.0
         self.outstanding: Dict[str, int] = {}
+        # Multiplexing affinity: model_id -> replica_id last used for it
+        # (reference: the router prefers replicas with the model loaded).
+        self.model_replica: Dict[str, str] = {}
         self.lock = threading.Lock()
 
 
@@ -77,19 +80,24 @@ class DeploymentResponseGenerator:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: str = "__call__",
-                 stream: bool = False):
+                 stream: bool = False, multiplexed_model_id: str = ""):
         self.deployment_name = deployment_name
         self._method_name = method_name
         self._stream = stream
+        self._multiplexed_model_id = multiplexed_model_id
         self._cache = _RouterCache()
 
     # -- fluent API (reference: handle.options / method access) ----------
     def options(self, *, method_name: Optional[str] = None,
-                stream: Optional[bool] = None) -> "DeploymentHandle":
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
         h = DeploymentHandle(
             self.deployment_name,
             method_name if method_name is not None else self._method_name,
-            self._stream if stream is None else stream)
+            self._stream if stream is None else stream,
+            self._multiplexed_model_id if multiplexed_model_id is None
+            else multiplexed_model_id)
         h._cache = self._cache  # share router state across variants
         return h
 
@@ -114,7 +122,7 @@ class DeploymentHandle:
                 c.version = routing["version"]
                 c.deployments = routing["deployments"]
 
-    def _pick_replica(self):
+    def _pick_replica(self, args: tuple = (), kwargs: Optional[dict] = None):
         c = self._cache
         deadline = time.monotonic() + 30
         while True:
@@ -129,14 +137,32 @@ class DeploymentHandle:
                     f"{self.deployment_name!r}")
             time.sleep(0.1)
             self._refresh(force=True)
+        router = (info or {}).get("request_router", "pow2")
+        max_ongoing = int((info or {}).get("max_ongoing_requests", 16))
         with c.lock:
-            if len(replicas) == 1:
-                rid, actor = replicas[0]
-            else:
-                # Power of two choices by local outstanding count.
-                a, b = random.sample(replicas, 2)
-                rid, actor = min(
-                    (a, b), key=lambda r: c.outstanding.get(r[0], 0))
+            rid_actor = None
+            if self._multiplexed_model_id:
+                # Affinity: reuse the replica that last served this model —
+                # its LRU cache has the weights in HBM.
+                want = c.model_replica.get(self._multiplexed_model_id)
+                for r in replicas:
+                    if r[0] == want:
+                        rid_actor = r
+                        break
+            if rid_actor is None and router == "prefix":
+                rid_actor = _prefix_pick(
+                    replicas, args, kwargs or {}, c.outstanding, max_ongoing)
+            if rid_actor is None:
+                if len(replicas) == 1:
+                    rid_actor = replicas[0]
+                else:
+                    # Power of two choices by local outstanding count.
+                    a, b = random.sample(replicas, 2)
+                    rid_actor = min(
+                        (a, b), key=lambda r: c.outstanding.get(r[0], 0))
+            rid, actor = rid_actor
+            if self._multiplexed_model_id:
+                c.model_replica[self._multiplexed_model_id] = rid
             c.outstanding[rid] = c.outstanding.get(rid, 0) + 1
         return rid, actor
 
@@ -149,15 +175,17 @@ class DeploymentHandle:
 
     # -- invocation ------------------------------------------------------
     def remote(self, *args, **kwargs):
-        rid, actor = self._pick_replica()
+        rid, actor = self._pick_replica(args, kwargs)
+        ctx = ({"multiplexed_model_id": self._multiplexed_model_id}
+               if self._multiplexed_model_id else None)
         try:
             if self._stream:
                 gen = actor.handle_request.options(
                     num_returns="dynamic").remote(
-                        self._method_name, args, kwargs)
+                        self._method_name, args, kwargs, ctx)
                 return DeploymentResponseGenerator(gen, self, rid)
             ref = actor.handle_request_unary.remote(
-                self._method_name, args, kwargs)
+                self._method_name, args, kwargs, ctx)
             return DeploymentResponse(ref, self, rid)
         except Exception:
             self._dec(rid)
@@ -165,4 +193,46 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment_name, self._method_name, self._stream))
+                (self.deployment_name, self._method_name, self._stream,
+                 self._multiplexed_model_id))
+
+
+def _prefix_pick(replicas, args, kwargs, outstanding, max_ongoing):
+    """Prefix-aware pick (reference: request_router/prefix_aware_router.py —
+    there for vLLM prefix-cache hits; here for the paged-KV prefix cache):
+    requests sharing a prompt prefix rendezvous-hash to the same replica so
+    its KV pages stay hot, unless that replica is overloaded relative to the
+    least-loaded one."""
+    # Explicit None checks: prompts are often numpy arrays, whose truth
+    # value (as in `a or b`) raises.
+    prompt = kwargs.get("prompt_ids")
+    if prompt is None:
+        prompt = kwargs.get("prompt")
+    if prompt is None and args:
+        a0 = args[0]
+        if isinstance(a0, dict):
+            prompt = a0.get("prompt_ids")
+            if prompt is None:
+                prompt = a0.get("prompt")
+        elif isinstance(a0, (str, list, tuple)):
+            prompt = a0
+        elif hasattr(a0, "__len__") and not isinstance(a0, (bytes,)):
+            prompt = a0  # ndarray of token ids
+    if prompt is None:
+        return None
+    if isinstance(prompt, str):
+        key = prompt[:64]
+    else:
+        try:
+            key = ",".join(str(int(t)) for t in list(prompt)[:16])
+        except (TypeError, ValueError):
+            return None
+    import hashlib
+
+    best = max(replicas, key=lambda r: hashlib.blake2b(
+        (key + "|" + r[0]).encode(), digest_size=8).digest())
+    load = outstanding.get(best[0], 0)
+    floor = min(outstanding.get(r[0], 0) for r in replicas)
+    if load - floor >= max(2, max_ongoing // 2):
+        return None  # overloaded: let pow-2 spread it
+    return best

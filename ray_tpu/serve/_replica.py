@@ -40,9 +40,10 @@ class ReplicaActor:
             raise AttributeError(f"deployment has no method {method_name!r}")
         return fn
 
-    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict):
+    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict,
+                       ctx: Optional[Dict[str, Any]] = None):
         """Streaming entry (called with num_returns="dynamic")."""
-        with self._track():
+        with self._track(), self._request_ctx(ctx):
             result = self._resolve_method(method_name)(*args, **kwargs)
             if inspect.isgenerator(result):
                 # Streamed via num_returns="dynamic" at the call site.
@@ -51,9 +52,34 @@ class ReplicaActor:
             yield result
 
     def handle_request_unary(self, method_name: str, args: Tuple,
-                             kwargs: Dict):
-        with self._track():
+                             kwargs: Dict,
+                             ctx: Optional[Dict[str, Any]] = None):
+        with self._track(), self._request_ctx(ctx):
             return self._resolve_method(method_name)(*args, **kwargs)
+
+    @staticmethod
+    def _request_ctx(ctx: Optional[Dict[str, Any]]):
+        """Install per-request serve context (today: the multiplexed model
+        id read by serve.get_multiplexed_model_id)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            token = None
+            model_id = (ctx or {}).get("multiplexed_model_id")
+            if model_id:
+                from ray_tpu.serve.multiplex import _set_current_model_id
+
+                token = _set_current_model_id(model_id)
+            try:
+                yield
+            finally:
+                if token is not None:
+                    from ray_tpu.serve.multiplex import _current_model_id
+
+                    _current_model_id.reset(token)
+
+        return cm()
 
     def _track(self):
         import contextlib

@@ -1,0 +1,120 @@
+"""@serve.batch — transparent request batching (reference:
+python/ray/serve/batching.py).
+
+Decorate a method/function that takes a LIST of items and returns a LIST of
+results; callers invoke it with a single item and get that item's result.
+Items queue per instance; a background thread assembles batches of up to
+`max_batch_size`, waiting at most `batch_wait_timeout_s` after the first
+item. On TPU deployments this is how single HTTP requests become the large
+MXU-friendly batches the hardware wants."""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+import weakref
+from concurrent.futures import Future
+from typing import Any, Callable, List, Optional
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable[[List[Any]], List[Any]],
+                 max_batch_size: int, batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max(1, max_batch_size)
+        self._wait = max(0.0, batch_wait_timeout_s)
+        self._q: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serve-batch")
+        self._thread.start()
+
+    def submit(self, item: Any) -> Future:
+        fut: Future = Future()
+        self._q.put((item, fut))
+        return fut
+
+    def _loop(self) -> None:
+        import time
+
+        while True:
+            item, fut = self._q.get()  # block for the first item
+            batch = [(item, fut)]
+            deadline = time.monotonic() + self._wait
+            while len(batch) < self._max:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            items = [b[0] for b in batch]
+            try:
+                results = self._fn(items)
+                if len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function returned {len(results)} "
+                        f"results for {len(items)} inputs")
+                for (_, f), r in zip(batch, results):
+                    f.set_result(r)
+            except BaseException as e:  # noqa: BLE001
+                for _, f in batch:
+                    if not f.done():
+                        f.set_exception(e)
+
+
+# Deployment classes are cloudpickled to the controller; nothing unpicklable
+# (locks, live queues) may sit in the decorator's closure — lazy state lives
+# on the instance / wrapper instead, guarded by this module-global lock.
+_INIT_LOCK = threading.Lock()
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01) -> Callable:
+    """Usable bare (@serve.batch) or parameterized
+    (@serve.batch(max_batch_size=32, batch_wait_timeout_s=0.05))."""
+
+    def decorator(fn: Callable) -> Callable:
+        key = f"__serve_batch_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            # Resolve module state by import, not global reference: this
+            # wrapper is cloudpickled by value with deployment classes, and
+            # a directly-referenced module-level lock would be pickled by
+            # value too (locks aren't picklable).
+            from ray_tpu.serve import batching as _mod
+
+            if len(args) == 2:  # bound method: (self, item)
+                self_obj, item = args
+                bq = getattr(self_obj, key, None)
+                if bq is None:
+                    with _mod._INIT_LOCK:
+                        bq = getattr(self_obj, key, None)
+                        if bq is None:
+                            bq = _mod._BatchQueue(
+                                lambda items, s=self_obj: fn(s, items),
+                                max_batch_size, batch_wait_timeout_s)
+                            setattr(self_obj, key, bq)
+            elif len(args) == 1:  # plain function: (item,)
+                (item,) = args
+                bq = wrapper.__dict__.get("_queue")
+                if bq is None:
+                    with _mod._INIT_LOCK:
+                        bq = wrapper.__dict__.get("_queue")
+                        if bq is None:
+                            bq = _mod._BatchQueue(
+                                fn, max_batch_size, batch_wait_timeout_s)
+                            wrapper._queue = bq
+            else:
+                raise TypeError(
+                    "@serve.batch functions take exactly one request item")
+            return bq.submit(item).result()
+
+        wrapper._is_serve_batch = True  # introspection hook
+        return wrapper
+
+    if _fn is not None:
+        return decorator(_fn)
+    return decorator

@@ -1,0 +1,92 @@
+"""Model multiplexing (reference: python/ray/serve/multiplex.py +
+_private/multiplex.py): many small models share one replica pool; each
+replica LRU-caches up to `max_num_models_per_replica` loaded models, and the
+router prefers the replica that already has the requested model in memory.
+
+Usage:
+    @serve.deployment
+    class ModelHost:
+        @serve.multiplexed(max_num_models_per_replica=3)
+        def get_model(self, model_id: str):
+            return load_model(model_id)           # expensive
+
+        def __call__(self, x):
+            model = self.get_model(serve.get_multiplexed_model_id())
+            return model(x)
+
+    handle.options(multiplexed_model_id="m7").remote(x)
+
+On TPU the cached "model" is typically a params pytree already resident in
+HBM — eviction frees HBM, and replica affinity avoids re-staging weights
+through host memory (the expensive part)."""
+
+from __future__ import annotations
+
+import contextvars
+import functools
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Optional
+
+_current_model_id: "contextvars.ContextVar[str]" = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the request currently being handled (reference:
+    serve.get_multiplexed_model_id)."""
+    return _current_model_id.get()
+
+
+def _set_current_model_id(model_id: str):
+    return _current_model_id.set(model_id)
+
+
+# Same constraint as batching.py: the deployment class is cloudpickled, so
+# no locks in decorator closures — lazy per-instance state + a global lock.
+_MUX_LOCK = threading.Lock()
+
+
+def multiplexed(_fn: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3) -> Callable:
+    """Decorate the model loader; calls are LRU-cached per replica."""
+
+    def decorator(fn: Callable) -> Callable:
+        key = f"__serve_mux_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(self, model_id: str):
+            # Import-resolved lock: see batching.py — the wrapper travels
+            # by value inside cloudpickled deployment classes.
+            from ray_tpu.serve import multiplex as _mod
+
+            with _mod._MUX_LOCK:
+                cache = getattr(self, key, None)
+                if cache is None:
+                    cache = OrderedDict()
+                    setattr(self, key, cache)
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+            model = fn(self, model_id)
+            with _mod._MUX_LOCK:
+                cache = getattr(self, key)
+                cache[model_id] = model
+                cache.move_to_end(model_id)
+                while len(cache) > max(1, max_num_models_per_replica):
+                    evicted_id, evicted = cache.popitem(last=False)
+                    # Give the model a chance to release device memory.
+                    release = getattr(evicted, "release", None)
+                    if callable(release):
+                        try:
+                            release()
+                        except Exception:
+                            pass
+            return model
+
+        wrapper._is_serve_multiplexed = True
+        return wrapper
+
+    if _fn is not None:
+        return decorator(_fn)
+    return decorator

@@ -1,0 +1,216 @@
+"""TPU-pod NodeProvider for the autoscaler (reference:
+autoscaler/_private/gcp/ + autoscaler/gcp/tpu.yaml + example-tpu-pod.yaml —
+there a GCE NodeProvider with TPU special-casing; here a provider that
+speaks the QueuedResources shape through a pluggable transport).
+
+Provisioning a slice is asynchronous and whole-slice-at-a-time: a
+QueuedResource request either becomes an ACTIVE slice (all hosts at once) or
+fails — so the provider models one *node* per slice host and transitions
+them PROVISIONING → RUNNING together when the slice lands. Host 0 advertises
+the `TPU-<gen>-<topo>-head` gang resource (accelerators.py), so a pending
+STRICT_PACK placement group over a slice head is exactly the demand signal
+that makes the autoscaler call create_node here.
+
+Transports:
+- `GceQueuedResourceTransport` builds the real REST calls. This build runs
+  with zero egress, so it refuses to run unless an endpoint/session is
+  injected — it exists to pin down the wire shape, not to pretend.
+- `FakeTPUTransport` simulates the control plane (delayed ACTIVE, then
+  spawns real nodelet subprocesses with TPU:n resources per host) — the
+  reference's fake_multi_node pattern, used by tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler import NodeProvider
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+PROVISIONING = "PROVISIONING"
+RUNNING = "RUNNING"
+DELETED = "DELETED"
+
+
+@dataclasses.dataclass
+class TPUPodConfig:
+    """Slice shape (reference: tpu.yaml node_config)."""
+
+    accelerator_type: str = "v5e-8"  # <gen>-<chips>
+    runtime_version: str = "tpu-vm-base"
+    project: str = ""
+    zone: str = ""
+    hosts_per_slice: int = 2
+    chips_per_host: int = 4
+    spot: bool = False
+
+
+@dataclasses.dataclass
+class TPUPodNode:
+    slice_name: str
+    host_index: int
+    state: str = PROVISIONING
+    backing: Any = None  # transport-specific handle (fake: local Node)
+
+
+class TPUPodNodeProvider(NodeProvider):
+    """One create_node call = one QueuedResource slice request; the
+    resulting slice surfaces as hosts_per_slice nodes."""
+
+    def __init__(self, config: TPUPodConfig, transport: "TPUTransport"):
+        self.config = config
+        self.transport = transport
+        self._nodes: List[TPUPodNode] = []
+        self._lock = threading.Lock()
+
+    def create_node(self, resources: Dict[str, float]) -> List[TPUPodNode]:
+        cfg = self.config
+        name = f"qr-{cfg.accelerator_type}-{uuid.uuid4().hex[:6]}"
+        hosts = [TPUPodNode(name, i) for i in range(cfg.hosts_per_slice)]
+        with self._lock:
+            self._nodes.extend(hosts)
+
+        def on_active(backings: List[Any]) -> None:
+            with self._lock:
+                for h, b in zip(hosts, backings):
+                    h.state = RUNNING
+                    h.backing = b
+            logger.info("TPU slice %s ACTIVE (%d hosts)", name, len(hosts))
+
+        def on_failed(reason: str) -> None:
+            with self._lock:
+                for h in hosts:
+                    h.state = DELETED
+                self._nodes[:] = [n for n in self._nodes
+                                  if n.slice_name != name]
+            logger.warning("TPU slice %s failed: %s", name, reason)
+
+        self.transport.create_queued_resource(
+            name, cfg, on_active=on_active, on_failed=on_failed)
+        return hosts
+
+    def terminate_node(self, node: TPUPodNode) -> None:
+        # Slices terminate whole: taking down one host releases the slice
+        # (ICI makes a partial slice useless).
+        with self._lock:
+            victims = [n for n in self._nodes
+                       if n.slice_name == node.slice_name]
+            self._nodes[:] = [n for n in self._nodes
+                              if n.slice_name != node.slice_name]
+        self.transport.delete_queued_resource(
+            node.slice_name, [v.backing for v in victims])
+        for v in victims:
+            v.state = DELETED
+
+    def nodes(self) -> List[TPUPodNode]:
+        with self._lock:
+            return [n for n in self._nodes if n.state != DELETED]
+
+
+class TPUTransport:
+    """Control-plane operations a provider needs (QueuedResources shape)."""
+
+    def create_queued_resource(self, name: str, cfg: TPUPodConfig, *,
+                               on_active: Callable, on_failed: Callable
+                               ) -> None:
+        raise NotImplementedError
+
+    def delete_queued_resource(self, name: str, backings: List[Any]) -> None:
+        raise NotImplementedError
+
+
+class GceQueuedResourceTransport(TPUTransport):
+    """Real GCE TPU API wire shape (reference: the REST calls the GCP
+    provider issues — tpu.googleapis.com v2 queuedResources). This
+    environment has no egress; constructing without an injected `session`
+    (a requests.Session-compatible object reachable from a GCP VM) raises
+    rather than pretending to work."""
+
+    def __init__(self, session: Any = None,
+                 endpoint: str = "https://tpu.googleapis.com/v2"):
+        if session is None:
+            raise RuntimeError(
+                "GceQueuedResourceTransport needs an authenticated HTTP "
+                "session (google-auth); this build has no network egress — "
+                "use FakeTPUTransport for local testing")
+        self.session = session
+        self.endpoint = endpoint
+
+    def request_body(self, name: str, cfg: TPUPodConfig) -> Dict[str, Any]:
+        """The QueuedResource creation body (kept as a method so tests can
+        pin the wire shape without a network)."""
+        return {
+            "tpu": {"node_spec": [{
+                "parent": f"projects/{cfg.project}/locations/{cfg.zone}",
+                "node_id": name,
+                "node": {
+                    "accelerator_type": cfg.accelerator_type,
+                    "runtime_version": cfg.runtime_version,
+                },
+            }]},
+            **({"spot": {}} if cfg.spot else {}),
+        }
+
+    def create_queued_resource(self, name, cfg, *, on_active, on_failed):
+        url = (f"{self.endpoint}/projects/{cfg.project}/locations/"
+               f"{cfg.zone}/queuedResources?queued_resource_id={name}")
+        resp = self.session.post(url, json=self.request_body(name, cfg))
+        if resp.status_code >= 300:
+            on_failed(f"HTTP {resp.status_code}")
+
+    def delete_queued_resource(self, name, backings):
+        pass  # DELETE {endpoint}/.../queuedResources/{name}
+
+
+class FakeTPUTransport(TPUTransport):
+    """Simulated control plane: after provision_delay_s the slice goes
+    ACTIVE and each host materializes as a real nodelet subprocess with
+    TPU resources (host 0 carries the slice-head gang resource)."""
+
+    def __init__(self, head_node, *, provision_delay_s: float = 0.5,
+                 fail: bool = False,
+                 object_store_memory: int = 64 * 1024 * 1024):
+        self.head_node = head_node
+        self.delay = provision_delay_s
+        self.fail = fail
+        self.object_store_memory = object_store_memory
+
+    def create_queued_resource(self, name, cfg, *, on_active, on_failed):
+        def provision():
+            time.sleep(self.delay)
+            if self.fail:
+                on_failed("simulated capacity shortage")
+                return
+            from ray_tpu._private.node import Node
+
+            gen = cfg.accelerator_type.split("-")[0]
+            topo = cfg.accelerator_type.split("-", 1)[-1]
+            backings = []
+            for i in range(cfg.hosts_per_slice):
+                resources = {"CPU": 1.0, "TPU": float(cfg.chips_per_host)}
+                if i == 0:
+                    resources[f"TPU-{gen}-{topo}-head"] = 1.0
+                backings.append(Node(
+                    head=False, gcs_address=self.head_node.gcs_address,
+                    resources=resources,
+                    object_store_memory=self.object_store_memory,
+                    session_dir=self.head_node.session_dir,
+                    node_name=f"{name}-host{i}"))
+            on_active(backings)
+
+        threading.Thread(target=provision, daemon=True,
+                         name=f"tpu-provision-{name}").start()
+
+    def delete_queued_resource(self, name, backings):
+        for b in backings:
+            if b is not None:
+                try:
+                    b.shutdown()
+                except Exception:
+                    pass

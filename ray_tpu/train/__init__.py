@@ -37,3 +37,8 @@ __all__ = [
     "get_dataset_shard",
     "report",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rec
+
+_rec("train")
+del _rec

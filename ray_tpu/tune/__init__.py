@@ -10,6 +10,8 @@ from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
@@ -59,6 +61,8 @@ __all__ = [
     "ASHAScheduler",
     "Checkpoint",
     "FIFOScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
     "PopulationBasedTraining",
     "ResultGrid",
     "TuneConfig",
@@ -75,3 +79,8 @@ __all__ = [
     "report",
     "uniform",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rec
+
+_rec("tune")
+del _rec

@@ -89,6 +89,130 @@ class ASHAScheduler:
         return ordered[k]
 
 
+class HyperBandScheduler:
+    """Synchronous HyperBand (reference: tune/schedulers/hyperband.py).
+
+    Trials are assigned round-robin to brackets at add time
+    (`on_trial_add`, called by the controller); each bracket runs
+    successive-halving rounds: once every member has recorded a value at
+    the bracket's current milestone (or finished on its own), the bottom
+    1 - 1/eta fraction of still-running members stops. Finished members'
+    values stay in the comparison — a trial that already ran to max_t is
+    the competitor everyone else is judged against, which keeps halving
+    meaningful even when the cluster runs trials one after another."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 max_t: int = 81, reduction_factor: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.eta = max(2, reduction_factor)
+        self.max_t = max_t
+        self.time_attr = time_attr
+        # s_max+1 brackets; bracket s starts at milestone max_t/eta^s.
+        self.s_max = int(math.log(max_t) / math.log(self.eta))
+        self._brackets: List[Dict[str, Any]] = [
+            {"milestone": max(1, int(max_t / self.eta ** s)),
+             "members": set(),
+             # trial_id -> value at the FIRST report crossing the rung
+             # (equal-budget comparison; later reports must not overwrite).
+             "recorded": {},
+             "last": {},  # trial_id -> latest value (for finished carries)
+             "stopped": set()}
+            for s in range(self.s_max, -1, -1)
+        ]
+        self._assignment: Dict[str, int] = {}
+        self._next_bracket = 0
+
+    def on_trial_add(self, trial) -> None:
+        if trial.trial_id in self._assignment:
+            return
+        idx = self._next_bracket % len(self._brackets)
+        self._assignment[trial.trial_id] = idx
+        self._brackets[idx]["members"].add(trial.trial_id)
+        self._next_bracket += 1
+
+    def on_result(self, trial, result: Dict[str, Any], trials) -> Any:
+        t = result.get(self.time_attr)
+        value = result.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        self.on_trial_add(trial)  # fallback for controllers without the hook
+        b = self._brackets[self._assignment[trial.trial_id]]
+        if trial.trial_id in b["stopped"]:
+            return STOP
+        b["last"][trial.trial_id] = float(value)
+        if t >= b["milestone"]:
+            b["recorded"].setdefault(trial.trial_id, float(value))
+        done = t >= self.max_t
+        decision = STOP if done else CONTINUE
+        # Halve once every member has a value at this rung or is finished.
+        status = {tr.trial_id: tr.status for tr in trials}
+        ready = all(
+            tid in b["recorded"]
+            or tid in b["stopped"]
+            or status.get(tid) in ("TERMINATED", "ERROR")
+            or (tid == trial.trial_id and done)
+            for tid in b["members"])
+        if t >= b["milestone"] and ready:
+            ordered = sorted(b["recorded"].items(), key=lambda p: p[1],
+                             reverse=(self.mode == "max"))
+            keep = max(1, len(ordered) // self.eta)
+            keep_ids = {tid for tid, _ in ordered[:keep]}
+            losers = {
+                tid for tid, _ in ordered[keep:]
+                if status.get(tid) not in ("TERMINATED", "ERROR")}
+            b["stopped"] |= losers
+            b["milestone"] = min(self.max_t, b["milestone"] * self.eta)
+            # Finished keepers carry their FINAL value into the next rung
+            # era as the standing bar (they trained at least as far as the
+            # new milestone); live survivors re-record at the new milestone.
+            b["recorded"] = {
+                tid: b["last"].get(tid, v) for tid, v in ordered
+                if status.get(tid) in ("TERMINATED", "ERROR")
+                and tid in keep_ids}
+            if trial.trial_id in losers:
+                return STOP
+        return decision
+
+
+class MedianStoppingRule:
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same point (reference:
+    tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        self._history: Dict[str, List[float]] = {}
+
+    def on_result(self, trial, result: Dict[str, Any], trials) -> Any:
+        t = result.get(self.time_attr, 0)
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        self._history.setdefault(trial.trial_id, []).append(float(value))
+        if t < self.grace:
+            return CONTINUE
+        others = [sum(h) / len(h) for tid, h in self._history.items()
+                  if tid != trial.trial_id and h]
+        if len(others) < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        mine = self._history[trial.trial_id]
+        best = max(mine) if self.mode == "max" else min(mine)
+        worse = best < median if self.mode == "max" else best > median
+        return STOP if worse else CONTINUE
+
+
 class PopulationBasedTraining:
     """PBT (reference: tune/schedulers/pbt.py:221): every
     perturbation_interval reports, bottom-quantile trials exploit a

@@ -160,6 +160,11 @@ class _TuneController:
                 for i, cfg in enumerate(configs)
             ]
             self._persist()
+        # Bracket-style schedulers (HyperBand) need membership up front.
+        on_add = getattr(self.scheduler, "on_trial_add", None)
+        if callable(on_add):
+            for t in self.trials:
+                on_add(t)
 
     # ------------------------------------------------------------------
     def run(self) -> ResultGrid:

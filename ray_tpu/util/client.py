@@ -1,0 +1,400 @@
+"""Ray-Client equivalent: remote drivers over one RPC connection
+(reference: python/ray/util/client/ + ray_client.proto — a gRPC proxy
+hosting a server-side driver; here the proxy speaks the framed-RPC plane
+and executes against a cluster-connected Worker).
+
+Server side (a process already connected to the cluster — the head driver
+or a `ray_tpu client-server` process):
+
+    port = ray_tpu.util.client.serve_client(0)
+
+Client side (any machine that can reach that port — needs NO shm access,
+no jax, no cluster bootstrap):
+
+    ray_tpu.init(address=f"ray://{host}:{port}")
+    @ray_tpu.remote
+    def f(x): ...
+    ray_tpu.get(f.remote(1))
+
+The client ships cloudpickled functions/classes; refs come back as opaque
+ids pinned server-side until the client releases them (or disconnects —
+the server drops the whole session's pins)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+# ----------------------------------------------------------------------
+# Server
+# ----------------------------------------------------------------------
+class _ClientSession:
+    """Server-side state for one client: pinned refs and actor handles."""
+
+    def __init__(self):
+        self.refs: Dict[bytes, Any] = {}  # ref id -> ObjectRef (pins it)
+        self.actors: Dict[bytes, Any] = {}  # actor id -> ActorHandle
+
+
+class ClientProxyServer:
+    def __init__(self):
+        from ray_tpu._private.rpc import RpcServer
+
+        self.server = RpcServer()
+        self.sessions: Dict[str, _ClientSession] = {}
+        self._lock = threading.Lock()
+
+    def _session(self, client_id: str) -> _ClientSession:
+        with self._lock:
+            s = self.sessions.get(client_id)
+            if s is None:
+                s = self.sessions[client_id] = _ClientSession()
+            return s
+
+    def _track(self, session: _ClientSession, refs: List[Any]) -> List[bytes]:
+        out = []
+        for r in refs:
+            session.refs[r.id.binary()] = r
+            out.append(r.id.binary())
+        return out
+
+    # -- handlers --------------------------------------------------------
+    # The proxy server shares the driver's event loop; every cluster op
+    # (put/get/submit) internally round-trips through that same loop, so
+    # handlers MUST run the op on an executor thread — running it inline
+    # would deadlock the loop against itself.
+    @staticmethod
+    async def _off_loop(fn):
+        import asyncio
+
+        return await asyncio.get_running_loop().run_in_executor(None, fn)
+
+    async def rpc_client_put(self, client_id: str, blob: bytes) -> bytes:
+        import ray_tpu
+
+        s = self._session(client_id)
+        ref = await self._off_loop(
+            lambda: ray_tpu.put(cloudpickle.loads(blob)))
+        return self._track(s, [ref])[0]
+
+    async def rpc_client_get(self, client_id: str, ids: List[bytes],
+                             get_timeout: Optional[float] = None) -> bytes:
+        import ray_tpu
+
+        s = self._session(client_id)
+        refs = [s.refs[i] for i in ids]
+        try:
+            values = await self._off_loop(
+                lambda: ray_tpu.get(refs, timeout=get_timeout))
+            return cloudpickle.dumps(("ok", values))
+        except BaseException as e:  # noqa: BLE001
+            return cloudpickle.dumps(("err", e))
+
+    async def rpc_client_task(self, client_id: str, fn_blob: bytes,
+                              args_blob: bytes,
+                              options: Dict[str, Any]) -> List[bytes]:
+        import ray_tpu
+
+        s = self._session(client_id)
+
+        def submit():
+            fn = cloudpickle.loads(fn_blob)
+            args, kwargs = self._load_args(s, args_blob)
+            rf = ray_tpu.remote(fn)
+            if options:
+                rf = rf.options(**options)
+            refs = rf.remote(*args, **kwargs)
+            return refs if isinstance(refs, list) else [refs]
+
+        return self._track(s, await self._off_loop(submit))
+
+    async def rpc_client_create_actor(self, client_id: str, cls_blob: bytes,
+                                      args_blob: bytes,
+                                      options: Dict[str, Any]) -> bytes:
+        import ray_tpu
+
+        s = self._session(client_id)
+
+        def create():
+            cls = cloudpickle.loads(cls_blob)
+            args, kwargs = self._load_args(s, args_blob)
+            ac = ray_tpu.remote(cls)
+            if options:
+                ac = ac.options(**options)
+            return ac.remote(*args, **kwargs)
+
+        handle = await self._off_loop(create)
+        aid = handle._actor_id.binary()
+        s.actors[aid] = handle
+        return aid
+
+    async def rpc_client_actor_call(self, client_id: str, actor_id: bytes,
+                                    method_name: str,
+                                    args_blob: bytes) -> List[bytes]:
+        s = self._session(client_id)
+        handle = s.actors[actor_id]
+
+        def call():
+            args, kwargs = self._load_args(s, args_blob)
+            return getattr(handle, method_name).remote(*args, **kwargs)
+
+        return self._track(s, [await self._off_loop(call)])
+
+    async def rpc_client_wait(self, client_id: str, ids: List[bytes],
+                              num_returns: int,
+                              wait_timeout: Optional[float] = None
+                              ) -> Tuple[List[bytes], List[bytes]]:
+        import ray_tpu
+
+        s = self._session(client_id)
+        refs = [s.refs[i] for i in ids]
+        ready, rest = await self._off_loop(
+            lambda: ray_tpu.wait(refs, num_returns=num_returns,
+                                 timeout=wait_timeout))
+        return ([r.id.binary() for r in ready],
+                [r.id.binary() for r in rest])
+
+    async def rpc_client_kill_actor(self, client_id: str,
+                                    actor_id: bytes) -> bool:
+        import ray_tpu
+
+        s = self._session(client_id)
+        handle = s.actors.pop(actor_id, None)
+        if handle is not None:
+            await self._off_loop(lambda: ray_tpu.kill(handle))
+        return True
+
+    async def rpc_client_release(self, client_id: str,
+                                 ids: List[bytes]) -> bool:
+        s = self._session(client_id)
+        for i in ids:
+            s.refs.pop(i, None)
+        return True
+
+    async def rpc_client_disconnect(self, client_id: str) -> bool:
+        with self._lock:
+            self.sessions.pop(client_id, None)
+        return True
+
+    def _load_args(self, session: _ClientSession, blob: bytes):
+        args, kwargs = cloudpickle.loads(blob)
+
+        def conv(a):
+            if isinstance(a, _RefMarker):
+                return session.refs[a.id]
+            return a
+
+        return ([conv(a) for a in args],
+                {k: conv(v) for k, v in kwargs.items()})
+
+    def start(self, port: int = 0) -> Tuple[str, int]:
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker()
+        self.server.port = port
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                self.server.register(name[4:], getattr(self, name))
+        addr = w.loop_thread.run(self.server.start())
+        self.address = addr
+        logger.info("client proxy listening on %s:%d", *addr)
+        return addr
+
+
+_proxy: Optional[ClientProxyServer] = None
+
+
+def serve_client(port: int = 0) -> Tuple[str, int]:
+    """Start the client proxy in this (cluster-connected) process."""
+    global _proxy
+    if _proxy is None:
+        _proxy = ClientProxyServer()
+        return _proxy.start(port)
+    return _proxy.address
+
+
+# ----------------------------------------------------------------------
+# Client
+# ----------------------------------------------------------------------
+class _RefMarker:
+    """How a ClientObjectRef travels inside pickled args."""
+
+    def __init__(self, id: bytes):  # noqa: A002
+        self.id = id
+
+
+class ClientObjectRef:
+    def __init__(self, id: bytes, client: "RayTpuClient"):  # noqa: A002
+        self.id = id
+        self._client = client
+
+    def __reduce__(self):
+        return (_RefMarker, (self.id,))
+
+    def __del__(self):
+        c = self._client
+        if c is not None and c.connected:
+            c._release(self.id)
+
+    def __repr__(self):
+        return f"ClientObjectRef({self.id.hex()[:12]}…)"
+
+
+class _ClientActorMethod:
+    def __init__(self, client, actor_id: bytes, name: str):
+        self._client = client
+        self._actor_id = actor_id
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        return self._client._actor_call(
+            self._actor_id, self._name, args, kwargs)
+
+
+class ClientActorHandle:
+    def __init__(self, client, actor_id: bytes):
+        object.__setattr__(self, "_client", client)
+        object.__setattr__(self, "_actor_id", actor_id)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientActorMethod(self._client, self._actor_id, name)
+
+
+class ClientRemoteFunction:
+    def __init__(self, client, fn, options: Dict[str, Any]):
+        self._client = client
+        self._fn_blob = cloudpickle.dumps(fn)
+        self._options = options
+
+    def options(self, **overrides) -> "ClientRemoteFunction":
+        out = ClientRemoteFunction.__new__(ClientRemoteFunction)
+        out._client = self._client
+        out._fn_blob = self._fn_blob
+        out._options = {**self._options, **overrides}
+        return out
+
+    def remote(self, *args, **kwargs):
+        return self._client._task(self._fn_blob, args, kwargs, self._options)
+
+
+class ClientActorClass:
+    def __init__(self, client, cls, options: Dict[str, Any]):
+        self._client = client
+        self._cls_blob = cloudpickle.dumps(cls)
+        self._options = options
+
+    def options(self, **overrides) -> "ClientActorClass":
+        out = ClientActorClass.__new__(ClientActorClass)
+        out._client = self._client
+        out._cls_blob = self._cls_blob
+        out._options = {**self._options, **overrides}
+        return out
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        return self._client._create_actor(self._cls_blob, args, kwargs,
+                                          self._options)
+
+
+class RayTpuClient:
+    """The remote-driver handle installed by init(address="ray://…")."""
+
+    def __init__(self, host: str, port: int):
+        import uuid
+
+        from ray_tpu._private.rpc import EventLoopThread, RpcClient
+
+        self.client_id = uuid.uuid4().hex
+        self.loop_thread = EventLoopThread("ray_client_io")
+        self.rpc = RpcClient(host, port, name="ray-client")
+        self.loop_thread.run(self.rpc.connect())
+        self.connected = True
+
+    def _call(self, method: str, **kwargs):
+        return self.loop_thread.run(
+            self.rpc.call(method, client_id=self.client_id, **kwargs))
+
+    # -- API mirrors -----------------------------------------------------
+    def put(self, value: Any) -> ClientObjectRef:
+        rid = self._call("client_put", blob=cloudpickle.dumps(value))
+        return ClientObjectRef(rid, self)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ClientObjectRef)
+        ids = [refs.id] if single else [r.id for r in refs]
+        status, payload = cloudpickle.loads(
+            self._call("client_get", ids=ids, get_timeout=timeout))
+        if status == "err":
+            raise payload
+        return payload[0] if single else payload
+
+    def remote(self, obj, **options):
+        import inspect
+
+        if inspect.isclass(obj):
+            return ClientActorClass(self, obj, options)
+        return ClientRemoteFunction(self, obj, options)
+
+    def kill(self, actor: ClientActorHandle) -> None:
+        self._call("client_kill_actor", actor_id=actor._actor_id)
+
+    def wait(self, refs, num_returns: int = 1,
+             timeout: Optional[float] = None):
+        by_id = {r.id: r for r in refs}
+        ready, rest = self._call(
+            "client_wait", ids=[r.id for r in refs],
+            num_returns=num_returns, wait_timeout=timeout)
+        return ([by_id[i] for i in ready], [by_id[i] for i in rest])
+
+    # -- plumbing --------------------------------------------------------
+    def _pack_args(self, args, kwargs) -> bytes:
+        return cloudpickle.dumps((list(args), dict(kwargs)))
+
+    def _task(self, fn_blob, args, kwargs, options) -> ClientObjectRef:
+        ids = self._call("client_task", fn_blob=fn_blob,
+                         args_blob=self._pack_args(args, kwargs),
+                         options=options)
+        refs = [ClientObjectRef(i, self) for i in ids]
+        return refs[0] if len(refs) == 1 else refs
+
+    def _create_actor(self, cls_blob, args, kwargs, options):
+        aid = self._call("client_create_actor", cls_blob=cls_blob,
+                         args_blob=self._pack_args(args, kwargs),
+                         options=options)
+        return ClientActorHandle(self, aid)
+
+    def _actor_call(self, actor_id, method, args, kwargs) -> ClientObjectRef:
+        ids = self._call("client_actor_call", actor_id=actor_id,
+                         method_name=method,
+                         args_blob=self._pack_args(args, kwargs))
+        return ClientObjectRef(ids[0], self)
+
+    def _release(self, ref_id: bytes) -> None:
+        try:
+            self.loop_thread.run_async(
+                self.rpc.call("client_release", client_id=self.client_id,
+                              ids=[ref_id]))
+        except Exception:
+            pass
+
+    def disconnect(self) -> None:
+        if not self.connected:
+            return
+        self.connected = False
+        try:
+            self._call("client_disconnect")
+        except Exception:
+            pass
+        try:
+            self.loop_thread.run(self.rpc.close())
+        except Exception:
+            pass
+        self.loop_thread.stop()

@@ -1,0 +1,102 @@
+"""joblib backend on the cluster (reference: python/ray/util/joblib/ —
+register_ray + a Parallel backend running batches as tasks). Usage:
+
+    from ray_tpu.util.joblib import register_ray
+    register_ray()
+    with joblib.parallel_config(backend="ray_tpu"):
+        Parallel(n_jobs=8)(delayed(f)(i) for i in range(100))
+"""
+
+from __future__ import annotations
+
+_backend_cls = None
+
+
+def _get_backend_cls():
+    global _backend_cls
+    if _backend_cls is not None:
+        return _backend_cls
+
+    from joblib._parallel_backends import ParallelBackendBase
+
+    class RayTpuBackend(ParallelBackendBase):
+        """Each joblib batch runs as one cluster task."""
+
+        supports_timeout = True
+        uses_threads = False
+        supports_sharedmem = False
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == -1:
+                import ray_tpu
+
+                try:
+                    return max(1, int(sum(
+                        n.get("resources_total", {}).get("CPU", 0)
+                        for n in ray_tpu.nodes())))
+                except Exception:
+                    return 4
+            return max(1, n_jobs or 1)
+
+        def configure(self, n_jobs=1, parallel=None, **kwargs):
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def apply_async(self, func, callback=None):
+            import ray_tpu
+
+            from ray_tpu.remote_function import RemoteFunction
+
+            run = _remote_runner()
+            ref = run.remote(func)
+
+            class _Future:
+                def get(self, timeout=None):
+                    return ray_tpu.get(ref, timeout=timeout)
+
+            fut = _Future()
+            if callback is not None:
+                import threading
+
+                def _notify():
+                    try:
+                        result = fut.get()
+                    except BaseException:  # noqa: BLE001
+                        return  # Parallel re-raises on its own get()
+                    callback(result)
+
+                threading.Thread(target=_notify, daemon=True).start()
+            return fut
+
+        def terminate(self):
+            pass
+
+        def abort_everything(self, ensure_ready=True):
+            pass
+
+    _backend_cls = RayTpuBackend
+    return RayTpuBackend
+
+
+_runner = None
+
+
+def _remote_runner():
+    """One shared @remote wrapper (avoids re-exporting the function per
+    batch)."""
+    global _runner
+    if _runner is None:
+        import ray_tpu
+
+        @ray_tpu.remote
+        def _joblib_batch(f):
+            return f()
+
+        _runner = _joblib_batch
+    return _runner
+
+
+def register_ray() -> None:
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", _get_backend_cls())

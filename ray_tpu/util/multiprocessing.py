@@ -1,0 +1,140 @@
+"""Drop-in `multiprocessing.Pool` on the cluster (reference:
+python/ray/util/multiprocessing/pool.py). Each "process" is an actor, so the
+pool spans nodes; functions/args go through the object plane."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    """multiprocessing.pool.AsyncResult lookalike over ObjectRefs."""
+
+    def __init__(self, refs: List[Any], single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            ray_tpu.get(self._refs, timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Pool(processes=N): N worker actors executing submitted callables."""
+
+    def __init__(self, processes: Optional[int] = None,
+                 initializer: Optional[Callable] = None,
+                 initargs: tuple = ()):
+        n = processes or 4
+
+        @ray_tpu.remote
+        class _PoolWorker:
+            def __init__(self, initializer=None, initargs=()):
+                if initializer is not None:
+                    initializer(*initargs)
+
+            def run(self, fn, args, kwargs):
+                return fn(*args, **(kwargs or {}))
+
+            def run_chunk(self, fn, chunk):
+                return [fn(*a) for a in chunk]
+
+        self._actors = [
+            _PoolWorker.options(num_cpus=1.0).remote(initializer, initargs)
+            for _ in range(n)
+        ]
+        self._rr = itertools.cycle(range(n))
+        self._closed = False
+
+    # -- submission ------------------------------------------------------
+    def _next(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+        return self._actors[next(self._rr)]
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: Optional[dict] = None) -> AsyncResult:
+        ref = self._next().run.remote(fn, args, kwds)
+        return AsyncResult([ref], single=True)
+
+    def apply(self, fn: Callable, args: tuple = (),
+              kwds: Optional[dict] = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable[Any],
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        items = [(x,) for x in iterable]
+        return self._chunked(fn, items, chunksize)
+
+    def map(self, fn: Callable, iterable: Iterable[Any],
+            chunksize: Optional[int] = None) -> List[Any]:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple],
+                chunksize: Optional[int] = None) -> List[Any]:
+        return self._chunked(fn, list(iterable), chunksize).get()
+
+    def imap(self, fn: Callable, iterable: Iterable[Any],
+             chunksize: Optional[int] = None):
+        refs = [self._next().run.remote(fn, (x,), None) for x in iterable]
+        for ref in refs:
+            yield ray_tpu.get(ref)
+
+    imap_unordered = imap  # ordering is already per-submission
+
+    def _chunked(self, fn, items: List[tuple],
+                 chunksize: Optional[int]) -> AsyncResult:
+        if chunksize is None:
+            chunksize = max(1, len(items) // (len(self._actors) * 4) or 1)
+        chunks = [items[i:i + chunksize]
+                  for i in range(0, len(items), chunksize)]
+        refs = [self._next().run_chunk.remote(fn, c) for c in chunks]
+
+        class _Flat(AsyncResult):
+            def get(self, timeout=None):
+                nested = ray_tpu.get(self._refs, timeout=timeout)
+                return [x for chunk in nested for x in chunk]
+
+        return _Flat(refs, single=False)
+
+    # -- lifecycle -------------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("join() before close()")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

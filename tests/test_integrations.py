@@ -1,0 +1,57 @@
+"""Ecosystem integrations: multiprocessing.Pool and joblib (reference:
+python/ray/util/multiprocessing, python/ray/util/joblib).
+
+Functions are defined inside the tests: module-level functions pickle by
+reference and the test module is not importable on workers (the same
+constraint the reference solves with runtime_env working_dir)."""
+
+
+def test_mp_pool_map(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def square(x):
+        return x * x
+
+    def addmul(a, b):
+        return a * 10 + b
+
+    with Pool(processes=2) as p:
+        assert p.map(square, range(10)) == [x * x for x in range(10)]
+        assert p.starmap(addmul, [(1, 2), (3, 4)]) == [12, 34]
+        assert p.apply(square, (7,)) == 49
+        r = p.apply_async(square, (9,))
+        assert r.get(timeout=30) == 81
+        assert list(p.imap(square, [2, 3])) == [4, 9]
+
+
+def test_mp_pool_initializer(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def init(v):
+        import os
+
+        os.environ["POOL_INIT_MARK"] = str(v)
+
+    def read(_):
+        import os
+
+        return os.environ.get("POOL_INIT_MARK")
+
+    with Pool(processes=1, initializer=init, initargs=(42,)) as p:
+        assert p.map(read, [0]) == ["42"]
+
+
+def test_joblib_backend(ray_start_regular):
+    import joblib
+
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+
+    def square(x):
+        return x * x
+
+    with joblib.parallel_config(backend="ray_tpu"):
+        out = joblib.Parallel(n_jobs=2)(
+            joblib.delayed(square)(i) for i in range(8))
+    assert out == [i * i for i in range(8)]

@@ -1,0 +1,27 @@
+"""Worker log tail-to-driver (reference: _private/log_monitor.py → GCS
+pubsub → driver stdout)."""
+
+import time
+
+
+def test_worker_prints_reach_driver(ray_start_regular, capfd):
+    ray_tpu = ray_start_regular
+    w = __import__("ray_tpu._private.worker", fromlist=["worker"])
+    w.global_worker().start_log_subscriber()
+
+    @ray_tpu.remote
+    def shout():
+        print("LOGPIPE-marker-12345")
+        return 1
+
+    assert ray_tpu.get(shout.remote()) == 1
+    # The nodelet tails every 0.5s; the driver long-polls. Allow a few secs.
+    deadline = time.time() + 10
+    seen = ""
+    while time.time() < deadline:
+        seen += capfd.readouterr().err
+        if "LOGPIPE-marker-12345" in seen:
+            break
+        time.sleep(0.2)
+    assert "LOGPIPE-marker-12345" in seen
+    assert "node=" in seen  # prefixed with provenance

@@ -1,0 +1,60 @@
+"""DQN tests (reference strategy: rllib learning tests — CartPole must
+actually learn; double-DQN + target net + replay + epsilon annealing)."""
+
+import numpy as np
+
+from ray_tpu.rllib import DQN, DQNConfig, ReplayBuffer
+
+
+def test_replay_buffer_wraps_and_samples():
+    buf = ReplayBuffer(capacity=8, obs_dim=2)
+    for i in range(12):  # overfill to exercise wrap-around
+        buf.add_batch(np.full((1, 2), i, np.float32),
+                      np.array([i]), np.array([float(i)]),
+                      np.full((1, 2), i + 1, np.float32),
+                      np.array([0.0]))
+    assert buf.size == 8
+    mb = buf.sample(16, np.random.default_rng(0))
+    assert mb["obs"].shape == (16, 2)
+    # Only the 8 newest transitions (4..11) remain after wrapping.
+    assert mb["rewards"].min() >= 4.0
+
+
+def test_dqn_components_roundtrip(ray_start_regular):
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=16)
+            .training(learn_start=64, batch_size=32, sgd_steps_per_iter=4)
+            .debugging(seed=0)
+            .build())
+    r1 = algo.train()
+    assert r1["env_steps_this_iter"] == 2 * 2 * 16
+    r2 = algo.train()
+    assert np.isfinite(r2["loss"])  # learning started by iter 2
+    assert 0.0 <= r2["epsilon"] <= 1.0
+    assert r2["epsilon"] < 1.0  # annealing moved
+
+
+def test_dqn_cartpole_learns(ray_start_regular):
+    algo = (DQNConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                         rollout_fragment_length=64)
+            .training(lr=1e-3, batch_size=64, sgd_steps_per_iter=64,
+                      target_update_period=128, learn_start=512,
+                      epsilon_anneal_steps=4000)
+            .debugging(seed=1)
+            .build())
+    first = None
+    best = 0.0
+    for _ in range(20):
+        r = algo.train()
+        if first is None and np.isfinite(r["episode_return_mean"]):
+            first = r["episode_return_mean"]
+        if np.isfinite(r["episode_return_mean"]):
+            best = max(best, r["episode_return_mean"])
+    assert first is not None
+    # ~10k env steps of DQN should clearly beat the random-policy start
+    # (measured curve: ~20 at iter 0 → ~65 by iter 19, seed 1).
+    assert best > max(40.0, 1.5 * first), (first, best)

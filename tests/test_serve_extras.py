@@ -1,0 +1,96 @@
+"""Serve request batching, model multiplexing, and prefix-aware routing
+(reference: serve/batching.py, serve/multiplex.py,
+request_router/prefix_aware_router.py)."""
+
+import time
+
+import pytest
+
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_shutdown(ray_start_regular):
+    yield ray_start_regular
+    serve.shutdown()
+
+
+def test_serve_batch_accumulates(serve_shutdown):
+    @serve.deployment(max_ongoing_requests=32)
+    class Batcher:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def compute(self, items):
+            # Whole-batch handler: one result per item, tagged with the
+            # batch size it rode in.
+            n = len(items)
+            return [(x * 2, n) for x in items]
+
+        def __call__(self, x):
+            return self.compute(x)
+
+    h = serve.run(Batcher.bind())
+    # Fire 8 concurrent requests; at least some must share a batch.
+    resps = [h.remote(i) for i in range(8)]
+    outs = [r.result(timeout=30) for r in resps]
+    assert sorted(v for v, _ in outs) == [0, 2, 4, 6, 8, 10, 12, 14]
+    assert max(n for _, n in outs) > 1, "no batching happened at all"
+
+
+def test_serve_batch_plain_function():
+    from ray_tpu.serve.batching import batch
+
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    def double(items):
+        return [x * 2 for x in items]
+
+    assert double(21) == 42
+
+
+def test_multiplexed_lru_and_context(serve_shutdown):
+    @serve.deployment
+    class Host:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.loads.append(model_id)
+            return f"model:{model_id}"
+
+        def __call__(self, _x):
+            mid = serve.get_multiplexed_model_id()
+            return (self.get_model(mid), list(self.loads))
+
+    h = serve.run(Host.bind())
+    out1, loads1 = h.options(multiplexed_model_id="a").remote(0).result(
+        timeout=30)
+    assert out1 == "model:a" and loads1 == ["a"]
+    # Cached: second request for "a" does not reload.
+    _, loads2 = h.options(multiplexed_model_id="a").remote(0).result(
+        timeout=30)
+    assert loads2 == ["a"]
+    # Load b, c → a evicted (LRU capacity 2); next a reloads.
+    h.options(multiplexed_model_id="b").remote(0).result(timeout=30)
+    h.options(multiplexed_model_id="c").remote(0).result(timeout=30)
+    _, loads3 = h.options(multiplexed_model_id="a").remote(0).result(
+        timeout=30)
+    assert loads3 == ["a", "b", "c", "a"]
+
+
+def test_prefix_router_affinity(serve_shutdown):
+    import os
+
+    @serve.deployment(num_replicas=2, request_router="prefix")
+    class Echo:
+        def __call__(self, prompt_ids):
+            return os.getpid()
+
+    h = serve.run(Echo.bind())
+    prompt = list(range(20))
+    pids = {h.remote(prompt_ids=prompt).result(timeout=30)
+            for _ in range(6)}
+    # Same prefix → same replica every time.
+    assert len(pids) == 1
+    other = [h.remote(prompt_ids=[99 - i for i in range(20)]).result(
+        timeout=30) for _ in range(3)]
+    assert len(set(other)) == 1  # the other prefix is sticky too

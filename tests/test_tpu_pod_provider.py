@@ -1,0 +1,95 @@
+"""TPU-pod NodeProvider (reference: autoscaler GCP TPU support —
+tpu.yaml / example-tpu-pod.yaml; here QueuedResources-shaped provisioning
+with a fake control plane, per the fake_multi_node test pattern) + usage
+stats recorder."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_gce_transport_refuses_without_session():
+    from ray_tpu.tpu_pod_provider import GceQueuedResourceTransport
+
+    with pytest.raises(RuntimeError, match="egress"):
+        GceQueuedResourceTransport()
+
+
+def test_gce_transport_wire_shape():
+    from ray_tpu.tpu_pod_provider import (
+        GceQueuedResourceTransport,
+        TPUPodConfig,
+    )
+
+    t = GceQueuedResourceTransport.__new__(GceQueuedResourceTransport)
+    body = t.request_body("qr-x", TPUPodConfig(
+        accelerator_type="v5e-16", project="p", zone="us-central2-b",
+        spot=True))
+    spec = body["tpu"]["node_spec"][0]
+    assert spec["parent"] == "projects/p/locations/us-central2-b"
+    assert spec["node"]["accelerator_type"] == "v5e-16"
+    assert "spot" in body
+
+
+def test_tpu_slice_provisions_and_schedules_gang():
+    """A STRICT_PACK PG over a slice head drives QueuedResource creation;
+    the fake slice lands and the PG schedules on it."""
+    from ray_tpu.autoscaler import Autoscaler
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.tpu_pod_provider import (
+        FakeTPUTransport,
+        TPUPodConfig,
+        TPUPodNodeProvider,
+    )
+    from ray_tpu.util import placement_group, remove_placement_group
+
+    c = Cluster(head_node_args={"num_cpus": 1, "node_name": "head",
+                                "object_store_memory": 128 * 1024 * 1024})
+    try:
+        c.connect()
+        cfg = TPUPodConfig(accelerator_type="v5e-8", hosts_per_slice=2,
+                           chips_per_host=4)
+        provider = TPUPodNodeProvider(
+            cfg, FakeTPUTransport(c.head_node, provision_delay_s=0.2))
+        # max_workers counts HOSTS; one v5e-8 slice = 2 hosts.
+        scaler = Autoscaler(provider, min_workers=0, max_workers=2,
+                            idle_timeout_s=300.0, interval_s=1.0)
+        scaler.start()
+        try:
+            # Gang bundle: the slice head + chips on both hosts.
+            pg = placement_group(
+                [{"TPU-v5e-8-head": 1.0, "TPU": 4.0}, {"TPU": 4.0}],
+                strategy="STRICT_SPREAD")
+            assert pg.ready(timeout=120), "slice never provisioned"
+            nodes = provider.nodes()
+            assert len(nodes) == 2
+            assert all(n.state == "RUNNING" for n in nodes)
+            remove_placement_group(pg)
+            # Whole-slice teardown: terminating one host releases both.
+            provider.terminate_node(nodes[0])
+            assert provider.nodes() == []
+        finally:
+            scaler.stop()
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_usage_stats_recorder(tmp_path, monkeypatch):
+    from ray_tpu._private import usage
+
+    usage.set_session_dir(str(tmp_path))
+    usage.record_library_usage("testlib")
+    snap = usage.usage_snapshot()
+    assert snap.get("testlib") == 1
+    import json
+
+    with open(tmp_path / "usage_stats.json") as f:
+        payload = json.load(f)
+    assert payload["libraries"]["testlib"] == 1
+    # Opt-out respected.
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    usage.record_library_usage("optout-lib")
+    assert "optout-lib" not in usage.usage_snapshot()

@@ -1,0 +1,81 @@
+"""HyperBand + MedianStoppingRule (reference: tune/schedulers/hyperband.py,
+median_stopping_rule.py). Unit-level decision tests plus a cluster run."""
+
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    STOP,
+    HyperBandScheduler,
+    MedianStoppingRule,
+)
+from ray_tpu.tune.trial import RUNNING, Trial
+
+
+def _trial(tid, **last):
+    t = Trial(trial_id=tid, config={})
+    t.status = RUNNING
+    t.last_result = last
+    return t
+
+
+def test_hyperband_halves_cohort():
+    sched = HyperBandScheduler(metric="acc", mode="max", max_t=9,
+                               reduction_factor=3)
+    # Put 3 trials in one bracket by pinning assignments.
+    trials = [_trial(f"t{i}") for i in range(3)]
+    for t in trials:
+        sched._assignment[t.trial_id] = 0
+        sched._brackets[0]["members"].add(t.trial_id)
+    milestone = sched._brackets[0]["milestone"]
+    # First two report at the milestone: cohort incomplete, both continue.
+    assert sched.on_result(trials[0], {"training_iteration": milestone,
+                                       "acc": 3.0}, trials) == CONTINUE
+    assert sched.on_result(trials[1], {"training_iteration": milestone,
+                                       "acc": 2.0}, trials) == CONTINUE
+    # Third (worst) completes the cohort → halving fires; keep 1 of 3.
+    assert sched.on_result(trials[2], {"training_iteration": milestone,
+                                       "acc": 1.0}, trials) == STOP
+    # Losers stay stopped; the winner continues.
+    assert sched.on_result(trials[1], {"training_iteration": milestone + 1,
+                                       "acc": 9.9}, trials) == STOP
+    assert sched.on_result(trials[0], {"training_iteration": milestone + 1,
+                                       "acc": 3.1}, trials) == CONTINUE
+
+
+def test_median_stopping_rule():
+    sched = MedianStoppingRule(metric="loss", mode="min", grace_period=2,
+                               min_samples_required=2)
+    good1, good2 = _trial("g1"), _trial("g2")
+    bad = _trial("b")
+    trials = [good1, good2, bad]
+    for step in range(1, 4):
+        assert sched.on_result(good1, {"training_iteration": step,
+                                       "loss": 0.1}, trials) == CONTINUE
+        assert sched.on_result(good2, {"training_iteration": step,
+                                       "loss": 0.2}, trials) == CONTINUE
+    # bad is past grace and far above the median of running averages.
+    assert sched.on_result(bad, {"training_iteration": 3,
+                                 "loss": 5.0}, trials) == STOP
+
+
+def test_hyperband_cluster_run(ray_start_regular, tmp_path):
+    def trainable(config):
+        import time as _t
+
+        for step in range(9):
+            tune.report({"acc": config["lr"] * (step + 1)})
+            _t.sleep(0.05)
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([3.0, 2.0, 1.0, 0.5])},
+        tune_config=tune.TuneConfig(
+            metric="acc", mode="max", max_concurrent_trials=4,
+            scheduler=tune.HyperBandScheduler(
+                metric="acc", mode="max", max_t=9, reduction_factor=3)),
+        run_config=tune.TuneRunConfig(storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    iters = {r.config["lr"]: len(r.metrics_history) for r in grid}
+    assert sum(iters.values()) < 4 * 9  # someone was halved away
+    assert grid.get_best_result().config["lr"] == 3.0
