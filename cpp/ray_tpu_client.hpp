@@ -1,0 +1,159 @@
+// C++ client for the ray_tpu xlang plane (reference: the C++ worker API
+// under cpp/include/ray/api — ray::Init / ray::Task(...).Remote() / Get —
+// which speaks protobuf+gRPC to the reference core; this client speaks the
+// length-prefixed binary protocol of ray_tpu/xlang/server.py instead).
+//
+// Contract: payloads are opaque byte strings both ways; the application
+// chooses its own serialization. Single-header, no dependencies beyond
+// POSIX sockets.
+//
+//   ray_tpu::Client c("127.0.0.1", port);
+//   std::string ref = c.Put("hello");          // object plane
+//   std::string v   = c.Get(ref);
+//   std::string out = c.Call("fn", "payload"); // inline utility call
+//   std::string r2  = c.SubmitTask("fn", "p"); // cluster task -> ref
+//   std::string id  = c.CreateActor("Cls", "init");
+//   std::string a   = c.CallActor(id, "method", "payload");
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+namespace ray_tpu {
+
+enum Op : uint8_t {
+  kCall = 1,
+  kPut = 2,
+  kGet = 3,
+  kTask = 4,
+  kActorNew = 5,
+  kActorCall = 6,
+  kRelease = 7,
+};
+
+class Client {
+ public:
+  Client(const std::string& host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1)
+      throw std::runtime_error("bad host " + host);
+    if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0)
+      throw std::runtime_error("connect() failed");
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  // Object plane: bytes in, 40-char ref id out.
+  std::string Put(const std::string& payload) {
+    return Request(kPut, payload);
+  }
+
+  std::string Get(const std::string& ref_hex) {
+    return Request(kGet, ref_hex);
+  }
+
+  // Release the server-side pin once done with a ref (Put/SubmitTask
+  // results). Skipping this leaks the object on the server for the
+  // session's lifetime.
+  void Release(const std::string& ref_hex) { Request(kRelease, ref_hex); }
+
+  // Inline utility call of a server-registered function.
+  std::string Call(const std::string& name, const std::string& payload) {
+    return Request(kCall, Named(name, payload));
+  }
+
+  // Cluster task on a registered function; returns a ref id for Get().
+  std::string SubmitTask(const std::string& name, const std::string& payload) {
+    return Request(kTask, Named(name, payload));
+  }
+
+  std::string CreateActor(const std::string& cls, const std::string& payload) {
+    return Request(kActorNew, Named(cls, payload));
+  }
+
+  std::string CallActor(const std::string& actor_id, const std::string& method,
+                        const std::string& payload) {
+    std::string body;
+    AppendU16(body, actor_id.size());
+    body += actor_id;
+    AppendU16(body, method.size());
+    body += method;
+    body += payload;
+    return Request(kActorCall, body);
+  }
+
+ private:
+  static void AppendU16(std::string& out, size_t v) {
+    out.push_back(static_cast<char>((v >> 8) & 0xff));
+    out.push_back(static_cast<char>(v & 0xff));
+  }
+
+  static std::string Named(const std::string& name,
+                           const std::string& payload) {
+    std::string body;
+    AppendU16(body, name.size());
+    body += name;
+    body += payload;
+    return body;
+  }
+
+  void WriteAll(const char* p, size_t n) {
+    while (n > 0) {
+      ssize_t w = ::write(fd_, p, n);
+      if (w <= 0) throw std::runtime_error("write() failed");
+      p += w;
+      n -= static_cast<size_t>(w);
+    }
+  }
+
+  void ReadAll(char* p, size_t n) {
+    while (n > 0) {
+      ssize_t r = ::read(fd_, p, n);
+      if (r <= 0) throw std::runtime_error("connection closed");
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+  }
+
+  std::string Request(Op op, const std::string& body) {
+    uint32_t len = htonl(static_cast<uint32_t>(body.size()));
+    std::string frame(reinterpret_cast<char*>(&len), 4);
+    frame.push_back(static_cast<char>(op));
+    frame += body;
+    WriteAll(frame.data(), frame.size());
+
+    char head[5];
+    ReadAll(head, 5);
+    uint32_t blen;
+    std::memcpy(&blen, head, 4);
+    blen = ntohl(blen);
+    std::string out(blen, '\0');
+    if (blen > 0) ReadAll(&out[0], blen);
+    if (head[4] != 0) throw std::runtime_error("xlang error: " + out);
+    return out;
+  }
+
+  int fd_ = -1;
+};
+
+}  // namespace ray_tpu

@@ -962,30 +962,102 @@ class Worker:
     async def _fetch_remote(self, object_id: ObjectID, node_id: bytes,
                             deadline: Optional[float]) -> ser.SerializedObject:
         """Pull an object from another node's store via its nodelet and cache
-        it in local shm (reference: ObjectManager Pull, C12)."""
+        it in local shm (reference: ObjectManager Pull, C12). Small objects
+        arrive in one RPC; anything over object_transfer_chunk_bytes streams
+        as concurrent chunk RPCs bounded by a per-process in-flight budget
+        (pull admission — reference: pull_manager.h:49)."""
         nodes = await self.gcs_client.call("list_nodes")
         target = next((n for n in nodes if n["node_id"] == node_id), None)
         if target is None:
             raise ObjectLostError(f"node for object {object_id} is gone")
+        cfg = get_config()
+        t = None if deadline is None else deadline - time.monotonic()
         client = RpcClient(*target["address"], name="fetch")
         try:
-            reply = await client.call(
-                "fetch_object", object_id=object_id.binary(),
-                timeout=None if deadline is None else deadline - time.monotonic())
+            info = await client.call(
+                "fetch_object_info", object_id=object_id.binary(), timeout=t)
+            if info is None:
+                raise ObjectLostError(
+                    f"object {object_id} not found on owner node")
+            total = sum(info["sizes"])
+            if total <= cfg.object_transfer_chunk_bytes:
+                reply = await client.call(
+                    "fetch_object", object_id=object_id.binary(), timeout=t)
+                if reply is None:
+                    raise ObjectLostError(
+                        f"object {object_id} not found on owner node")
+                obj = ser.SerializedObject(
+                    reply["metadata"], reply["buffers"], [])
+            else:
+                obj = await self._fetch_chunked(
+                    client, object_id, info, deadline)
         except (ConnectionLost, RemoteError, OSError) as e:
             # Node died faster than the GCS noticed — same as "gone".
             raise ObjectLostError(
                 f"node holding {object_id} unreachable: {e!r}") from e
         finally:
             await client.close()
-        if reply is None:
-            raise ObjectLostError(f"object {object_id} not found on owner node")
-        obj = ser.SerializedObject(reply["metadata"], reply["buffers"], [])
         try:
             self.shm.put_serialized(object_id, obj)
         except Exception:
             pass
         return obj
+
+    @property
+    def _pull_sem(self) -> "asyncio.Semaphore":
+        # Shared across every concurrent fetch in this process: the
+        # admission budget is per puller, not per object.
+        sem = self.__dict__.get("_pull_sem_obj")
+        if sem is None:
+            sem = asyncio.Semaphore(
+                max(1, get_config().object_transfer_max_inflight_chunks))
+            self.__dict__["_pull_sem_obj"] = sem
+        return sem
+
+    async def _fetch_chunked(self, client: RpcClient, object_id: ObjectID,
+                             info: Dict[str, Any],
+                             deadline: Optional[float]
+                             ) -> ser.SerializedObject:
+        cfg = get_config()
+        chunk = cfg.object_transfer_chunk_bytes
+        total = sum(info["sizes"])
+        flat = bytearray(total)
+        self._last_fetch_chunks = -(-total // chunk)  # test introspection
+
+        async def pull_one(off: int) -> None:
+            length = min(chunk, total - off)
+            async with self._pull_sem:
+                t = (None if deadline is None
+                     else deadline - time.monotonic())
+                data = await client.call(
+                    "fetch_object_chunk", object_id=object_id.binary(),
+                    offset=off, length=length, timeout=t)
+            if data is None:
+                raise ObjectLostError(
+                    f"object {object_id} vanished mid-transfer")
+            flat[off:off + len(data)] = data
+
+        tasks = [asyncio.ensure_future(pull_one(off))
+                 for off in range(0, total, chunk)]
+        try:
+            await asyncio.gather(*tasks)
+        except BaseException:
+            # First failure: cancel siblings and drain them BEFORE the
+            # caller closes the client — orphaned tasks would log
+            # never-retrieved exceptions and pin the flat buffer.
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
+        # Zero-copy re-slice of the assembled bytes into the original
+        # buffer boundaries (the views keep `flat` alive).
+        buffers: List[Any] = []
+        pos = 0
+        view = memoryview(flat)
+        for n in info["sizes"]:
+            buffers.append(view[pos:pos + n])
+            pos += n
+        return ser.SerializedObject(info["metadata"], buffers, [])
 
     async def _resolve_from_owner(
         self, ref: ObjectRef, deadline: Optional[float]

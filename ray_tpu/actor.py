@@ -18,13 +18,18 @@ class ActorMethod:
         self._num_returns = num_returns
         self._tensor_transport = tensor_transport
 
-    def options(self, num_returns: int = 1, tensor_transport: str = "",
+    def options(self, num_returns: Optional[int] = None,
+                tensor_transport: Optional[str] = None,
                 **_ignored) -> "ActorMethod":
         """tensor_transport="device" keeps returned jax.Arrays in the actor's
         HBM (reference: @ray.method(tensor_transport=...), RDT); see
-        ray_tpu.experimental.device_objects."""
-        return ActorMethod(self._handle, self._method_name, num_returns,
-                           tensor_transport)
+        ray_tpu.experimental.device_objects. None means "keep the current
+        setting" so chained .options() calls compose."""
+        return ActorMethod(
+            self._handle, self._method_name,
+            self._num_returns if num_returns is None else num_returns,
+            self._tensor_transport if tensor_transport is None
+            else tensor_transport)
 
     def bind(self, *args, **kwargs):
         """Build a DAG node from this method (reference: dag/dag_node.py)."""

@@ -23,6 +23,19 @@ from ray_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 
+def _node_key(node: Any) -> str:
+    """GCS node id (hex) of a provider node — must match the node_id used
+    by state.list_workers() or the busy check silently never matches and
+    the autoscaler terminates nodes with leased workers."""
+    nid = getattr(node, "node_id", None)  # _private/node.py Node: bytes
+    if nid is None:
+        backing = getattr(node, "backing", None)  # TPUPodNode → Node
+        nid = getattr(backing, "node_id", None)
+    if isinstance(nid, bytes):
+        return nid.hex()
+    return str(nid) if nid is not None else f"anon-{id(node)}"
+
+
 class NodeProvider:
     """Reference: autoscaler/node_provider.py — create/terminate/list."""
 
@@ -151,8 +164,7 @@ class Autoscaler:
             return
         now = time.monotonic()
         for node in list(self.provider.nodes()):
-            nid = getattr(node, "node_id_hex", None) or id(node)
-            key = str(nid)
+            key = _node_key(node)
             if key in busy_nodes:
                 self._idle_since.pop(key, None)
                 continue

@@ -138,6 +138,8 @@ class Nodelet:
         self.workers: Dict[WorkerID, WorkerHandle] = {}
         self._gcs: Optional[RpcClient] = None
         self._background: List[asyncio.Task] = []
+        # Spilled objects materialized for chunked transfer: id -> (obj, ts).
+        self._transfer_cache: Dict[bytes, Tuple[Any, float]] = {}
         self._lease_waiters: List[asyncio.Event] = []
         # pg bundles: (pg_id, bundle_index) -> {"resources": .., "state": ..}
         self._bundles: Dict[Tuple[bytes, int], Dict[str, Any]] = {}
@@ -524,6 +526,75 @@ class Nodelet:
             "metadata": bytes(obj.metadata),
             "buffers": [bytes(b) for b in obj.buffers],
         }
+
+    def _read_object_for_transfer(self, object_id: bytes):
+        """Sealed object lookup (shm, then spill) shared by the whole-object
+        and chunked fetch paths. Shm reads are cheap memoryviews; a SPILLED
+        object materializes from disk, so a chunked pull must not re-read
+        the whole file per chunk — recently-materialized spilled objects are
+        held in a tiny TTL cache for the duration of the transfer."""
+        from ray_tpu._private.ids import ObjectID
+
+        oid = ObjectID(object_id)
+        obj = self.store.get_serialized(oid)
+        if obj is not None:
+            return obj
+        now = time.monotonic()
+        cached = self._transfer_cache.get(object_id)
+        if cached is not None and now - cached[1] < 30.0:
+            self._transfer_cache[object_id] = (cached[0], now)
+            return cached[0]
+        from ray_tpu.core.object_store import spill_read
+
+        obj = spill_read(os.path.join(
+            self.session_dir, "spill", self.node_id.hex()), oid)
+        if obj is not None:
+            self._transfer_cache[object_id] = (obj, now)
+            # Evict stale entries so the cache never outgrows one or two
+            # in-flight transfers.
+            for k in [k for k, (_, ts) in self._transfer_cache.items()
+                      if now - ts > 30.0]:
+                self._transfer_cache.pop(k, None)
+        return obj
+
+    async def rpc_fetch_object_info(
+            self, object_id: bytes) -> Optional[Dict[str, Any]]:
+        """Chunked-pull step 1: sizes only, so the puller can plan chunk
+        ranges and apply admission control (reference: PullManager learns
+        object sizes before activating pulls, pull_manager.h:49)."""
+        obj = self._read_object_for_transfer(object_id)
+        if obj is None:
+            return None
+        return {
+            "metadata": bytes(obj.metadata),
+            "sizes": [len(b) for b in obj.buffers],
+        }
+
+    async def rpc_fetch_object_chunk(
+            self, object_id: bytes, offset: int,
+            length: int) -> Optional[bytes]:
+        """Chunked-pull step 2: one slice of the logical concatenation of
+        the object's buffers (reference: ObjectManager chunked Push/Pull,
+        object_buffer_pool.h). The copy is chunk-sized — bounded memory per
+        RPC regardless of object size."""
+        obj = self._read_object_for_transfer(object_id)
+        if obj is None:
+            return None
+        out = bytearray()
+        pos = 0
+        for buf in obj.buffers:
+            n = len(buf)
+            if pos + n <= offset:
+                pos += n
+                continue
+            start = max(0, offset - pos)
+            take = min(n - start, offset + length - (pos + start))
+            if take > 0:
+                out += memoryview(buf)[start:start + take]
+            pos += n
+            if len(out) >= length:
+                break
+        return bytes(out)
 
     async def rpc_ping(self) -> str:
         return "pong"

@@ -75,6 +75,17 @@ class DashboardActor:
         loop = asyncio.get_running_loop()
         if path == "/healthz":
             return 200, b'"ok"'
+        if path.rstrip("/") == "/metrics":
+            # Prometheus text exposition (reference: the per-node metrics
+            # agent + prometheus_exporter.py; single scrape endpoint here).
+            from ray_tpu.util.metrics import prometheus_text
+
+            try:
+                text = await loop.run_in_executor(None, prometheus_text)
+                return 200, text.encode()
+            except Exception as e:
+                logger.exception("metrics exposition failed")
+                return 500, json.dumps({"error": str(e)}).encode()
         table = {
             "/api/summary": state.cluster_summary,
             "/api/nodes": state.list_nodes,

@@ -65,12 +65,14 @@ class _RefSource:
 
 @dataclasses.dataclass
 class _MapBatches:
-    fn: Callable
+    fn: Optional[Callable]
     batch_size: Optional[int]
     num_cpus: float = 1.0
     window: int = DEFAULT_WINDOW
     name: str = "MapBatches"
     fn_kwargs: Optional[Dict[str, Any]] = None
+    # Set by _fuse_plan: a chain of map ops executed inside ONE task.
+    fused_stages: Optional[List["_MapBatches"]] = None
 
 
 @dataclasses.dataclass
@@ -94,11 +96,39 @@ class _MapBatchesActor:
 
 
 def _apply_map_batches(op: _MapBatches, block: Block) -> Block:
-    outs = []
-    kwargs = op.fn_kwargs or {}
-    for batch in iter_block_batches(block, op.batch_size):
-        outs.append(normalize_batch_output(op.fn(batch, **kwargs)))
-    return block_concat(outs) if outs else {}
+    for stage in op.fused_stages or [op]:
+        outs = []
+        kwargs = stage.fn_kwargs or {}
+        for batch in iter_block_batches(block, stage.batch_size):
+            outs.append(normalize_batch_output(stage.fn(batch, **kwargs)))
+        block = block_concat(outs) if outs else {}
+    return block
+
+
+# ---------------------------------------------------------------------------
+# Plan optimization
+# ---------------------------------------------------------------------------
+def _fuse_plan(plan: List[Any]) -> List[Any]:
+    """Fuse consecutive task-based map ops into one (reference: Data's
+    OperatorFusionRule, _internal/logical/rules/operator_fusion.py). A
+    map→map chain otherwise pays one task dispatch + one object-store
+    round trip per stage per block; fused, each block crosses the plane
+    once. Actor ops don't fuse (they pin state to a pool)."""
+    out: List[Any] = [plan[0]]
+    for op in plan[1:]:
+        prev = out[-1]
+        if (isinstance(op, _MapBatches) and isinstance(prev, _MapBatches)
+                and prev.num_cpus == op.num_cpus):
+            stages = list(prev.fused_stages or [prev])
+            fused = _MapBatches(
+                fn=None, batch_size=None, num_cpus=op.num_cpus,
+                window=min(prev.window, op.window),
+                name=f"{prev.name}->{op.name}")
+            fused.fused_stages = stages + [op]
+            out[-1] = fused
+            continue
+        out.append(op)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -106,6 +136,7 @@ def _apply_map_batches(op: _MapBatches, block: Block) -> Block:
 # ---------------------------------------------------------------------------
 def _exec_stream(plan: List[Any]) -> Iterator[Any]:
     """Plan → iterator of Block ObjectRefs (pull-based; bounded windows)."""
+    plan = _fuse_plan(plan)
     src = plan[0]
     if isinstance(src, _RefSource):
         stream: Iterator[Any] = iter(src.refs)

@@ -143,11 +143,17 @@ class DeploymentHandle:
             rid_actor = None
             if self._multiplexed_model_id:
                 # Affinity: reuse the replica that last served this model —
-                # its LRU cache has the weights in HBM.
+                # its LRU cache has the weights in HBM. Overload escape
+                # (same rule as _prefix_pick): a hot model must spill to
+                # other replicas rather than queue unboundedly on one.
                 want = c.model_replica.get(self._multiplexed_model_id)
+                floor = min((c.outstanding.get(r[0], 0) for r in replicas),
+                            default=0)
                 for r in replicas:
                     if r[0] == want:
-                        rid_actor = r
+                        load = c.outstanding.get(want, 0)
+                        if load - floor < max(2, max_ongoing // 2):
+                            rid_actor = r
                         break
             if rid_actor is None and router == "prefix":
                 rid_actor = _prefix_pick(

@@ -67,6 +67,7 @@ class TPUPodNodeProvider(NodeProvider):
         self.config = config
         self.transport = transport
         self._nodes: List[TPUPodNode] = []
+        self._cancelled: set = set()  # slice names terminated mid-provision
         self._lock = threading.Lock()
 
     def create_node(self, resources: Dict[str, float]) -> List[TPUPodNode]:
@@ -78,9 +79,21 @@ class TPUPodNodeProvider(NodeProvider):
 
         def on_active(backings: List[Any]) -> None:
             with self._lock:
-                for h, b in zip(hosts, backings):
-                    h.state = RUNNING
-                    h.backing = b
+                if name in self._cancelled:
+                    cancelled = True
+                else:
+                    cancelled = False
+                    for h, b in zip(hosts, backings):
+                        h.state = RUNNING
+                        h.backing = b
+            if cancelled:
+                # terminate_node raced the provision thread: the slice landed
+                # after it was already released — tear it straight down so no
+                # untracked hosts join the cluster.
+                logger.info("TPU slice %s landed after cancellation; "
+                            "tearing down", name)
+                self.transport.delete_queued_resource(name, backings)
+                return
             logger.info("TPU slice %s ACTIVE (%d hosts)", name, len(hosts))
 
         def on_failed(reason: str) -> None:
@@ -103,6 +116,8 @@ class TPUPodNodeProvider(NodeProvider):
                        if n.slice_name == node.slice_name]
             self._nodes[:] = [n for n in self._nodes
                               if n.slice_name != node.slice_name]
+            if any(v.state == PROVISIONING for v in victims):
+                self._cancelled.add(node.slice_name)
         self.transport.delete_queued_resource(
             node.slice_name, [v.backing for v in victims])
         for v in victims:

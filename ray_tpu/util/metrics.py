@@ -177,3 +177,46 @@ def query_metrics() -> Dict[str, Dict[str, Any]]:
                         cur["sum"] += val["sum"]
                         cur["count"] += val["count"]
     return merged
+
+
+def prometheus_text() -> str:
+    """Cluster metrics in Prometheus text exposition format (reference:
+    _private/prometheus_exporter.py serving the metrics agent's registry;
+    here generated straight from the GCS-merged view and served by the
+    dashboard's /metrics route)."""
+    lines = []
+    for name, m in sorted(query_metrics().items()):
+        pname = name.replace(".", "_").replace("-", "_")
+        if m.get("description"):
+            lines.append(f"# HELP {pname} {m['description']}")
+        kind = m["kind"]
+        lines.append(f"# TYPE {pname} "
+                     f"{'counter' if kind == 'counter' else 'gauge' if kind == 'gauge' else 'histogram'}")
+        for tags, val in sorted(m["values"].items()):
+            label = ",".join(f'{k}="{_escape_label(v)}"' for k, v in tags)
+            base = f"{pname}{{{label}}}" if label else pname
+            if kind in ("counter", "gauge"):
+                lines.append(f"{base} {val}")
+                continue
+            # Histogram: cumulative buckets + sum + count.
+            cum = 0
+            for bound, n in zip(val["boundaries"], val["counts"]):
+                cum += n
+                le = f'le="{bound}"'
+                l2 = f"{label},{le}" if label else le
+                lines.append(f"{pname}_bucket{{{l2}}} {cum}")
+            cum += val["counts"][-1]
+            le = 'le="+Inf"'
+            l2 = f"{label},{le}" if label else le
+            lines.append(f"{pname}_bucket{{{l2}}} {cum}")
+            suffix = f"{{{label}}}" if label else ""
+            lines.append(f"{pname}_sum{suffix} {val['sum']}")
+            lines.append(f"{pname}_count{suffix} {val['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def _escape_label(value) -> str:
+    """Prometheus label-value escaping (\\, \", newline) — one bad tag must
+    not invalidate the whole scrape body."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))

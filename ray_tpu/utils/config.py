@@ -24,8 +24,13 @@ class RayTpuConfig:
     # sizes plasma at 30% of system memory — we default smaller and grow.
     object_store_memory: int = 2 * 1024**3
     # Chunk size for node-to-node object transfer (reference: 5MiB chunks in
-    # object_manager.h).
+    # object_manager.h). Objects larger than one chunk stream as concurrent
+    # chunk RPCs instead of a single giant frame.
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # Pull admission: max chunk RPCs in flight per puller process across ALL
+    # concurrent fetches (reference: PullManager admission control,
+    # pull_manager.h:49; PushManager max_chunks_in_flight).
+    object_transfer_max_inflight_chunks: int = 8
 
     # --- scheduling ---
     # Max worker leases requested in flight per scheduling key (reference:

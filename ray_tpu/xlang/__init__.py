@@ -1,0 +1,11 @@
+"""Cross-language plane (reference: the C++ worker API under cpp/ and the
+Java worker's xlang calls — java/api .../Ray.java; both speak protobuf/gRPC
+to the core there). Here non-Python clients speak a deliberately tiny
+length-prefixed binary protocol to an XlangServer hosted by any
+cluster-connected process; payloads are opaque bytes (each language layers
+its own serialization, as the reference's xlang contract does with
+msgpack)."""
+
+from ray_tpu.xlang.server import XlangServer, register, serve_xlang
+
+__all__ = ["XlangServer", "register", "serve_xlang"]

@@ -1,0 +1,200 @@
+"""XlangServer: the wire boundary for non-Python clients.
+
+Protocol (all integers big-endian):
+
+  request  := u32 body_len | u8 op | body
+  response := u32 body_len | u8 status | body      (status 0=ok, 1=error)
+
+  op 1 CALL : u16 nlen | name | payload            -> payload
+  op 2 PUT  : payload                              -> 40-char ref hex
+  op 3 GET  : 40-char ref hex                      -> payload
+  op 4 TASK : u16 nlen | name | payload            -> 40-char ref hex
+  op 5 ACTOR_NEW  : u16 nlen | name | payload      -> actor id hex
+  op 6 ACTOR_CALL : u16 alen | actor_hex | u16 mlen | method | payload
+                                                   -> payload
+
+CALL runs a registered function inline on the server (utility RPC); TASK
+submits it as a cluster task on registered-name functions, so xlang work
+schedules like any other task. Payloads are opaque bytes end to end —
+the cross-language contract is "bytes in, bytes out" (apps bring their own
+serialization), mirroring how the reference crosses languages with
+msgpack-encoded buffers rather than shared object models.
+
+Reference counterparts: cpp/src/ray/ (C++ worker API), java runtime xlang
+calls; the C++ client for THIS protocol lives in cpp/ray_tpu_client.hpp.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+OP_CALL = 1
+OP_PUT = 2
+OP_GET = 3
+OP_TASK = 4
+OP_ACTOR_NEW = 5
+OP_ACTOR_CALL = 6
+OP_RELEASE = 7  # drop the server-side pin of a PUT/TASK ref
+
+_registry: Dict[str, Callable[[bytes], bytes]] = {}
+_actor_registry: Dict[str, Any] = {}
+
+
+def register(name: str, fn: Callable[[bytes], bytes]) -> None:
+    """Expose `fn(payload: bytes) -> bytes` to xlang clients under `name`."""
+    _registry[name] = fn
+
+
+def register_actor_class(name: str, cls: Any) -> None:
+    """Expose an actor class: xlang ACTOR_NEW creates it (ctor gets the
+    payload bytes), ACTOR_CALL invokes bytes-in/bytes-out methods."""
+    _actor_registry[name] = cls
+
+
+class XlangServer:
+    def __init__(self):
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._actors: Dict[str, Any] = {}  # actor id hex -> handle
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                head = await reader.readexactly(5)
+                (body_len,), op = struct.unpack(">I", head[:4]), head[4]
+                body = await reader.readexactly(body_len)
+                try:
+                    out = await self._dispatch(op, body)
+                    status = 0
+                except Exception as e:  # noqa: BLE001
+                    out = f"{type(e).__name__}: {e}".encode()
+                    status = 1
+                writer.write(struct.pack(">I", len(out)) + bytes([status])
+                             + out)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    @staticmethod
+    def _named(body: bytes) -> Tuple[str, bytes]:
+        (nlen,) = struct.unpack(">H", body[:2])
+        return body[2:2 + nlen].decode(), body[2 + nlen:]
+
+    async def _dispatch(self, op: int, body: bytes) -> bytes:
+        import ray_tpu
+
+        loop = asyncio.get_running_loop()
+        if op == OP_CALL:
+            name, payload = self._named(body)
+            fn = _registry[name]
+            return await loop.run_in_executor(None, fn, payload)
+        if op == OP_PUT:
+            ref = await loop.run_in_executor(None, ray_tpu.put, bytes(body))
+            _pin(ref)
+            return ref.id.hex().encode()
+        if op == OP_GET:
+            ref_hex = body.decode()
+            value = await loop.run_in_executor(
+                None, lambda: _get_by_hex(ref_hex))
+            if not isinstance(value, (bytes, bytearray, memoryview)):
+                raise TypeError(
+                    f"xlang GET of non-bytes value ({type(value).__name__})")
+            return bytes(value)
+        if op == OP_TASK:
+            name, payload = self._named(body)
+            fn = _registry[name]
+
+            def submit():
+                rf = ray_tpu.remote(lambda p, f=fn: f(p))
+                return rf.remote(payload)
+
+            ref = await loop.run_in_executor(None, submit)
+            _pin(ref)
+            return ref.id.hex().encode()
+        if op == OP_ACTOR_NEW:
+            name, payload = self._named(body)
+            cls = _actor_registry[name]
+
+            def create():
+                return ray_tpu.remote(cls).remote(payload)
+
+            handle = await loop.run_in_executor(None, create)
+            hexid = handle._actor_id.hex()
+            self._actors[hexid] = handle
+            return hexid.encode()
+        if op == OP_ACTOR_CALL:
+            (alen,) = struct.unpack(">H", body[:2])
+            actor_hex = body[2:2 + alen].decode()
+            rest = body[2 + alen:]
+            (mlen,) = struct.unpack(">H", rest[:2])
+            method = rest[2:2 + mlen].decode()
+            payload = rest[2 + mlen:]
+            handle = self._actors[actor_hex]
+
+            def call():
+                ref = getattr(handle, method).remote(payload)
+                return ray_tpu.get(ref, timeout=600)
+
+            out = await loop.run_in_executor(None, call)
+            if not isinstance(out, (bytes, bytearray, memoryview)):
+                raise TypeError("xlang actor method must return bytes")
+            return bytes(out)
+        if op == OP_RELEASE:
+            # Clients must release refs they are done with: the server pins
+            # them on the client's behalf (util/client.py has the same
+            # contract via client_release), and a leak here is unbounded
+            # store growth.
+            _pins.pop(body.decode(), None)
+            return b"ok"
+        raise ValueError(f"unknown xlang op {op}")
+
+    async def start(self, host: str = "127.0.0.1",
+                    port: int = 0) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        addr = self._server.sockets[0].getsockname()
+        return addr[0], addr[1]
+
+
+# Refs created on behalf of xlang clients are pinned here (the client holds
+# only a hex id; the Python-side session is the owner).
+_pins: Dict[str, Any] = {}
+
+
+def _pin(ref) -> None:
+    _pins[ref.id.hex()] = ref
+
+
+def _get_by_hex(ref_hex: str):
+    import ray_tpu
+
+    ref = _pins.get(ref_hex)
+    if ref is None:
+        raise KeyError(f"unknown xlang ref {ref_hex}")
+    return ray_tpu.get(ref, timeout=600)
+
+
+_server: Optional[XlangServer] = None
+
+
+def serve_xlang(port: int = 0) -> Tuple[str, int]:
+    """Start the xlang server in this (cluster-connected) process."""
+    global _server
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.global_worker()
+    if _server is None:
+        _server = XlangServer()
+        return w.loop_thread.run(_server.start(port=port))
+    sock = _server._server.sockets[0].getsockname()
+    return sock[0], sock[1]

@@ -1,0 +1,51 @@
+"""Chunked inter-node object transfer with pull admission (reference:
+ObjectManager chunked Push/Pull + PullManager admission control,
+src/ray/object_manager/pull_manager.h:49). Own module: needs a private
+cluster with a small transfer chunk size configured via env."""
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import NodeAffinitySchedulingStrategy
+
+
+def test_chunked_cross_node_fetch(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES",
+                       str(1024 * 1024))
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_MAX_INFLIGHT_CHUNKS", "4")
+    import ray_tpu.utils.config as cfgmod
+
+    old_cfg = cfgmod._config
+    cfgmod._config = None
+    c = Cluster(head_node_args={"num_cpus": 1, "node_name": "head",
+                                "object_store_memory": 64 * 1024 * 1024})
+    c.add_node(num_cpus=2, node_name="w1",
+               object_store_memory=64 * 1024 * 1024)
+    try:
+        c.connect()
+        w1 = next(n for n in ray_tpu.nodes()
+                  if n.get("labels", {}).get("node_name") == "w1")
+
+        @ray_tpu.remote
+        def produce():
+            return np.arange(1_000_000, dtype=np.float64)  # ~8 MB
+
+        ref = produce.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=w1["node_id"].hex())).remote()
+        val = ray_tpu.get(ref, timeout=120)
+        np.testing.assert_array_equal(
+            val, np.arange(1_000_000, dtype=np.float64))
+        # The driver-side fetch actually went through the chunked path.
+        from ray_tpu._private import worker as worker_mod
+
+        assert getattr(worker_mod.global_worker(),
+                       "_last_fetch_chunks", 0) >= 8
+        # Cached locally now: a second get is instant and identical.
+        val2 = ray_tpu.get(ref, timeout=30)
+        assert float(val2[-1]) == 999_999.0
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        cfgmod._config = old_cfg

@@ -1,0 +1,43 @@
+"""Map-operator fusion (reference: Data OperatorFusionRule,
+_internal/logical/rules/operator_fusion.py)."""
+
+import numpy as np
+
+from ray_tpu import data as rdata
+from ray_tpu.data.dataset import _MapBatches, _fuse_plan
+
+
+def test_fuse_plan_collapses_map_chain():
+    ds = (rdata.range(8)
+          .map_batches(lambda b: {"id": b["id"] + 1})
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .map(lambda r: {"id": r["id"] + 3}))
+    fused = _fuse_plan(ds._plan)
+    maps = [op for op in fused if isinstance(op, _MapBatches)]
+    assert len(maps) == 1  # three logical maps -> one task per block
+    assert len(maps[0].fused_stages) == 3
+    assert "->" in maps[0].name
+
+
+def test_fuse_plan_keeps_actor_stage_separate():
+    class Stateful:
+        def __call__(self, batch):
+            return batch
+
+    ds = (rdata.range(8)
+          .map_batches(lambda b: {"id": b["id"] + 1})
+          .map_batches(Stateful, concurrency=1)
+          .map_batches(lambda b: {"id": b["id"] * 2}))
+    fused = _fuse_plan(ds._plan)
+    assert len(fused) == 4  # source + map + actor + map (no cross-fusion)
+
+
+def test_fused_chain_results_match(ray_start_regular):
+    ds = (rdata.range(100)
+          .map_batches(lambda b: {"id": b["id"] + 1}, batch_size=16)
+          .map_batches(lambda b: {"id": b["id"] * 2}, batch_size=32)
+          .filter(lambda r: r["id"] % 4 == 0))
+    got = sorted(r["id"] for r in ds.take_all())
+    expected = sorted(x for x in ((i + 1) * 2 for i in range(100))
+                      if x % 4 == 0)
+    assert got == expected

@@ -35,6 +35,22 @@ def test_dashboard_endpoints(ray_start_regular):
     nodes = fetch("/api/nodes")
     assert nodes and nodes[0]["alive"]
 
+    # Prometheus exposition (reference: prometheus_exporter.py).
+    from ray_tpu.util import metrics as um
+
+    c = um.Counter("dash_scrape_total", "scrapes", tag_keys=("who",))
+    c.inc(3, tags={"who": "test"})
+    h = um.Histogram("dash_lat_s", boundaries=(0.1, 1.0))
+    h.observe(0.05)
+    um.flush()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=60) as r:
+        text = r.read().decode()
+    assert "# TYPE dash_scrape_total counter" in text
+    assert 'dash_scrape_total{who="test"} 3.0' in text
+    assert 'dash_lat_s_bucket{le="0.1"} 1' in text
+    assert "dash_lat_s_count 1" in text
+
 
 def test_job_submission_lifecycle(ray_start_regular):
     from ray_tpu.job_submission import JobSubmissionClient
