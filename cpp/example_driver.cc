@@ -34,6 +34,7 @@ int main(int argc, char** argv) {
   c.CallActor(actor, "add", "5");
   std::string total = c.CallActor(actor, "add", "7");
   std::printf("ACTOR %s\n", total.c_str());
+  c.Release(actor);  // kills the cluster actor
 
   std::printf("CPP-DRIVER-OK\n");
   return 0;

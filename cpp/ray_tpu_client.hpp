@@ -73,9 +73,10 @@ class Client {
   }
 
   // Release the server-side pin once done with a ref (Put/SubmitTask
-  // results). Skipping this leaks the object on the server for the
-  // session's lifetime.
-  void Release(const std::string& ref_hex) { Request(kRelease, ref_hex); }
+  // results) or an actor id (CreateActor result — the actor is killed).
+  // Skipping this leaks the object/actor on the server for the session's
+  // lifetime.
+  void Release(const std::string& id_hex) { Request(kRelease, id_hex); }
 
   // Inline utility call of a server-registered function.
   std::string Call(const std::string& name, const std::string& payload) {

@@ -975,19 +975,16 @@ class Worker:
         client = RpcClient(*target["address"], name="fetch")
         try:
             info = await client.call(
-                "fetch_object_info", object_id=object_id.binary(), timeout=t)
+                "fetch_object_info", object_id=object_id.binary(),
+                inline_below=cfg.object_transfer_chunk_bytes, timeout=t)
             if info is None:
                 raise ObjectLostError(
                     f"object {object_id} not found on owner node")
-            total = sum(info["sizes"])
-            if total <= cfg.object_transfer_chunk_bytes:
-                reply = await client.call(
-                    "fetch_object", object_id=object_id.binary(), timeout=t)
-                if reply is None:
-                    raise ObjectLostError(
-                        f"object {object_id} not found on owner node")
+            if "buffers" in info:
+                # Small object: came back whole in the info reply (one RPC
+                # total — the common path pays no extra round trip).
                 obj = ser.SerializedObject(
-                    reply["metadata"], reply["buffers"], [])
+                    info["metadata"], info["buffers"], [])
             else:
                 obj = await self._fetch_chunked(
                     client, object_id, info, deadline)

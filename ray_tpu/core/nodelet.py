@@ -558,17 +558,24 @@ class Nodelet:
         return obj
 
     async def rpc_fetch_object_info(
-            self, object_id: bytes) -> Optional[Dict[str, Any]]:
-        """Chunked-pull step 1: sizes only, so the puller can plan chunk
-        ranges and apply admission control (reference: PullManager learns
-        object sizes before activating pulls, pull_manager.h:49)."""
+            self, object_id: bytes,
+            inline_below: int = 0) -> Optional[Dict[str, Any]]:
+        """Chunked-pull step 1: sizes, so the puller can plan chunk ranges
+        and apply admission control (reference: PullManager learns object
+        sizes before activating pulls, pull_manager.h:49). Objects at or
+        under `inline_below` come back whole in this same reply — the
+        common small-object fetch stays one RPC."""
         obj = self._read_object_for_transfer(object_id)
         if obj is None:
             return None
-        return {
-            "metadata": bytes(obj.metadata),
-            "sizes": [len(b) for b in obj.buffers],
-        }
+        sizes = [len(b) for b in obj.buffers]
+        if inline_below and sum(sizes) <= inline_below:
+            return {
+                "metadata": bytes(obj.metadata),
+                "sizes": sizes,
+                "buffers": [bytes(b) for b in obj.buffers],
+            }
+        return {"metadata": bytes(obj.metadata), "sizes": sizes}
 
     async def rpc_fetch_object_chunk(
             self, object_id: bytes, offset: int,
@@ -681,6 +688,14 @@ class Nodelet:
         idle_ttl = 60.0
         while not self._shutting_down:
             await asyncio.sleep(0.2)
+            # Expire transfer-cache entries even when no further fetch ever
+            # arrives — a finished chunked pull must not pin a materialized
+            # multi-GB spilled object for the nodelet's lifetime.
+            if self._transfer_cache:
+                now = time.monotonic()
+                for k in [k for k, (_, ts) in self._transfer_cache.items()
+                          if now - ts > 30.0]:
+                    self._transfer_cache.pop(k, None)
             for wid, w in list(self.workers.items()):
                 code = w.proc.poll()
                 if code is not None:

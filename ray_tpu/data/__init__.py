@@ -13,7 +13,9 @@ from ray_tpu.data.dataset import (
     range,  # noqa: A004
     range_tensor,
     read_csv,
+    read_json,
     read_parquet,
+    read_text,
 )
 from ray_tpu.data.iterator import DataIterator
 
@@ -28,7 +30,9 @@ __all__ = [
     "range",
     "range_tensor",
     "read_csv",
+    "read_json",
     "read_parquet",
+    "read_text",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rec

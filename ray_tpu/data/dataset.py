@@ -480,6 +480,46 @@ class Dataset:
                               for k, v in block.items()})
             pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
 
+    def write_json(self, path: str) -> None:
+        """One JSONL file per block (reference: Dataset.write_json)."""
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            with open(os.path.join(path, f"part-{i:05d}.jsonl"), "w") as f:
+                for row in block_to_items(block):
+                    if not isinstance(row, dict):
+                        row = {VALUE_COL: row}
+                    f.write(json.dumps(
+                        {k: (v.tolist() if isinstance(v, np.ndarray)
+                             else v.item() if isinstance(v, np.generic)
+                             else v) for k, v in row.items()}) + "\n")
+
+    def write_csv(self, path: str) -> None:
+        import csv
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, block in enumerate(self.iter_blocks()):
+            cols = list(block.keys())
+            with open(os.path.join(path, f"part-{i:05d}.csv"), "w",
+                      newline="") as f:
+                w = csv.writer(f)
+                w.writerow(cols)
+                for j in _range(block_num_rows(block)):
+                    w.writerow([block[c][j] for c in cols])
+
+    def to_pandas(self):
+        """Materialize into one pandas DataFrame (driver memory)."""
+        import pandas as pd
+
+        blocks = list(self.iter_blocks())
+        if not blocks:
+            return pd.DataFrame()
+        return pd.concat([pd.DataFrame(dict(b)) for b in blocks],
+                         ignore_index=True)
+
     def stats(self) -> str:
         names = [getattr(op, "name", type(op).__name__) for op in self._plan]
         return " -> ".join(names)
@@ -605,12 +645,7 @@ def from_pandas(df) -> Dataset:
 
 def read_parquet(path: str) -> Dataset:
     """One block per parquet file (reference: read_api.py read_parquet)."""
-    import glob
-    import os
-
-    paths = ([os.path.join(path, p) for p in sorted(glob.glob(
-        os.path.join(path, "*.parquet")))] if os.path.isdir(path)
-        else sorted(glob.glob(path)) or [path])
+    paths = _expand_paths(path, ".parquet")
 
     def gen():
         import pyarrow.parquet as pq
@@ -633,3 +668,58 @@ def read_csv(path: str) -> Dataset:
             yield block_from_items(rows)
 
     return Dataset([_Source(gen, name="ReadCSV")])
+
+
+def _expand_paths(path: str, suffix: str) -> List[str]:
+    import glob
+    import os
+
+    if os.path.isdir(path):
+        # glob already returns dir-prefixed paths — no second join.
+        return sorted(glob.glob(os.path.join(path, f"*{suffix}")))
+    return sorted(glob.glob(path)) or [path]
+
+
+def read_json(path: str, *, block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:
+    """JSONL file(s) → dataset, one or more blocks per file (reference:
+    read_api.py read_json)."""
+    paths = _expand_paths(path, ".jsonl")
+
+    def gen():
+        import json
+
+        for p in paths:
+            rows = []
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rows.append(json.loads(line))
+                    if len(rows) >= block_rows:
+                        yield block_from_items(rows)
+                        rows = []
+            if rows:
+                yield block_from_items(rows)
+
+    return Dataset([_Source(gen, name="ReadJSON")])
+
+
+def read_text(path: str, *, block_rows: int = DEFAULT_BLOCK_ROWS) -> Dataset:
+    """Text file(s) → one row per line, column "text" (reference:
+    read_api.py read_text)."""
+    paths = _expand_paths(path, ".txt")
+
+    def gen():
+        for p in paths:
+            lines = []
+            with open(p) as f:
+                for line in f:
+                    lines.append({"text": line.rstrip("\n")})
+                    if len(lines) >= block_rows:
+                        yield block_from_items(lines)
+                        lines = []
+            if lines:
+                yield block_from_items(lines)
+
+    return Dataset([_Source(gen, name="ReadText")])

@@ -13,6 +13,7 @@ from ray_tpu.rllib.dqn import (
     ReplayBuffer,
 )
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner
 from ray_tpu.rllib.learner import (
     LearnerGroup,
     PPOLearner,
@@ -29,6 +30,9 @@ __all__ = [
     "DQNLearnerConfig",
     "DQNModule",
     "EnvRunnerGroup",
+    "IMPALA",
+    "IMPALAConfig",
+    "IMPALALearner",
     "ReplayBuffer",
     "LearnerGroup",
     "PPO",

@@ -1,0 +1,262 @@
+"""IMPALA (reference: rllib/algorithms/impala/ — asynchronous env runners
+feeding a central learner, with V-trace off-policy correction for the
+policy lag between the behavior weights that sampled a trajectory and the
+learner weights that consume it).
+
+TPU-first: the V-trace recursion is a `lax.scan` inside one jitted update
+(no Python loop over time), so the learner step is a single compiled
+program; the async plumbing is ray_tpu.wait over in-flight sample futures
+— rollouts from stale weights are corrected, not discarded."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.rl_module import RLModule
+
+
+@dataclasses.dataclass
+class IMPALALearnerConfig:
+    lr: float = 5e-4
+    gamma: float = 0.99
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    rho_clip: float = 1.0  # V-trace rho-bar
+    c_clip: float = 1.0  # V-trace c-bar
+    max_grad_norm: float = 40.0
+
+
+class IMPALALearner:
+    """Jitted V-trace actor-critic update over [T, N] trajectories."""
+
+    def __init__(self, module: RLModule, config: IMPALALearnerConfig,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.module = module
+        self.cfg = config
+        self.opt = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(config.lr))
+        self.params = module.init_params(jax.random.PRNGKey(seed))
+        self.opt_state = self.opt.init(self.params)
+        net = module.net
+        cfg = config
+
+        def vtrace(values, next_value, rewards, dones, rhos):
+            """V-trace targets vs (scan from the end; reference:
+            IMPALA paper eq. 1, rllib vtrace_jax-equivalent)."""
+            rho_bar = jnp.minimum(rhos, cfg.rho_clip)
+            c_bar = jnp.minimum(rhos, cfg.c_clip)
+            nonterm = 1.0 - dones
+            # values_{t+1}: shift; bootstrap with next_value at the end.
+            values_tp1 = jnp.concatenate(
+                [values[1:], next_value[None]], axis=0)
+            deltas = rho_bar * (
+                rewards + cfg.gamma * nonterm * values_tp1 - values)
+
+            def step(carry, xs):
+                delta, c, nt = xs
+                acc = delta + cfg.gamma * nt * c * carry
+                return acc, acc
+
+            _, acc = jax.lax.scan(
+                step, jnp.zeros_like(next_value),
+                (deltas, c_bar, nonterm), reverse=True)
+            vs = values + acc
+            vs_tp1 = jnp.concatenate([vs[1:], next_value[None]], axis=0)
+            # Policy-gradient advantage uses the V-trace targets.
+            pg_adv = rho_bar * (
+                rewards + cfg.gamma * nonterm * vs_tp1 - values)
+            return vs, pg_adv
+
+        def loss_fn(params, batch):
+            T, N = batch["actions"].shape
+            obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
+            logits, values = net.apply({"params": params}, obs)
+            logits = logits.reshape(T, N, -1)
+            values = values.reshape(T, N)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            rhos = jnp.exp(logp - batch["behavior_logp"])
+            vs, pg_adv = vtrace(
+                jax.lax.stop_gradient(values), batch["next_value"],
+                batch["rewards"], batch["dones"],
+                jax.lax.stop_gradient(rhos))
+            pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
+            vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
+            entropy = -jnp.mean(jnp.sum(
+                jax.nn.softmax(logits) * logp_all, axis=-1))
+            return (pg_loss + cfg.vf_coeff * vf_loss
+                    - cfg.entropy_coeff * entropy)
+
+        def update(params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def update(self, rollout: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        batch = {
+            "obs": jnp.asarray(rollout["obs"], jnp.float32),
+            "actions": jnp.asarray(rollout["actions"], jnp.int32),
+            "behavior_logp": jnp.asarray(rollout["logp"], jnp.float32),
+            "rewards": jnp.asarray(rollout["rewards"], jnp.float32),
+            "dones": jnp.asarray(rollout["dones"], jnp.float32),
+            "next_value": jnp.asarray(rollout["last_values"], jnp.float32),
+        }
+        self.params, self.opt_state, loss = self._update(
+            self.params, self.opt_state, batch)
+        return {"loss": float(loss)}
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+
+class IMPALAConfig:
+    def __init__(self):
+        self._env_fn: Optional[Callable] = None
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_length = 32
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.learner = IMPALALearnerConfig()
+
+    def environment(self, env: Any = None, *,
+                    env_fn: Optional[Callable] = None) -> "IMPALAConfig":
+        if env_fn is not None:
+            self._env_fn = env_fn
+        elif isinstance(env, str):
+            name = env
+
+            def make():
+                import gymnasium
+
+                return gymnasium.make(name)
+
+            self._env_fn = make
+        else:
+            self._env_fn = env
+        return self
+
+    def env_runners(self, *, num_env_runners: int = 2,
+                    num_envs_per_env_runner: int = 4,
+                    rollout_fragment_length: int = 32) -> "IMPALAConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_length = rollout_fragment_length
+        return self
+
+    def training(self, **overrides) -> "IMPALAConfig":
+        for k, v in overrides.items():
+            if hasattr(self.learner, k):
+                setattr(self.learner, k, v)
+            elif k == "model_hidden":
+                self.hidden = tuple(v)
+            else:
+                raise ValueError(f"unknown training option {k!r}")
+        return self
+
+    def debugging(self, *, seed: int = 0) -> "IMPALAConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALA:
+    """Async actor-learner loop: runners ALWAYS have a sample in flight;
+    each training_step consumes whichever rollouts finished, V-trace
+    corrects their policy lag, and only the consumed runners get fresh
+    weights + a new in-flight request (reference: impala.py
+    training_step's learner/actor decoupling)."""
+
+    def __init__(self, config: IMPALAConfig):
+        assert config._env_fn is not None, "call .environment(...) first"
+        self.config = config
+        probe = config._env_fn()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        self.module = RLModule(obs_dim, num_actions, config.hidden)
+        self.learner = IMPALALearner(self.module, config.learner, config.seed)
+        Runner = ray_tpu.remote(SingleAgentEnvRunner)
+        self.runners = [
+            Runner.options(num_cpus=1.0).remote(
+                config._env_fn, self.module, config.num_envs_per_runner,
+                config.seed + 1000 * i)
+            for i in range(config.num_env_runners)
+        ]
+        weights = self.learner.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners],
+                    timeout=120)
+        self._inflight: Dict[Any, Any] = {
+            r.sample.remote(config.rollout_length): r for r in self.runners}
+        self.iteration = 0
+        self._return_window: List[float] = []
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                timeout=300)
+        losses = []
+        steps = 0
+        weights = None
+        for ref in ready:
+            runner = self._inflight.pop(ref)
+            rollout = ray_tpu.get(ref)
+            losses.append(self.learner.update(rollout)["loss"])
+            steps += rollout["actions"].size
+            # Fresh weights only for the runner being relaunched — the
+            # others keep sampling with their (lagged) weights; V-trace
+            # absorbs the difference.
+            weights = self.learner.get_weights()
+            ray_tpu.get(runner.set_weights.remote(weights), timeout=60)
+            self._inflight[runner.sample.remote(cfg.rollout_length)] = runner
+        outs = ray_tpu.get(
+            [r.episode_returns.remote() for r in self.runners], timeout=60)
+        self._return_window.extend(x for sub in outs for x in sub)
+        self._return_window = self._return_window[-100:]
+        dt = time.perf_counter() - t0
+        return {
+            "loss": float(np.mean(losses)) if losses else float("nan"),
+            "rollouts_consumed": len(losses),
+            "env_steps_this_iter": steps,
+            "env_steps_per_s": steps / dt if dt > 0 else 0.0,
+            "episode_return_mean": (float(np.mean(self._return_window))
+                                    if self._return_window else float("nan")),
+        }
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        out = self.training_step()
+        out["training_iteration"] = self.iteration
+        return out
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

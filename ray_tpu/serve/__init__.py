@@ -110,8 +110,15 @@ def start(http_port: int = 0, _with_http: bool = True):
 
 def run(target: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/",
-        _blocking: bool = False) -> DeploymentHandle:
-    """Deploy an application (reference: serve/api.py:691 serve.run)."""
+        _blocking: bool = False,
+        _local_testing_mode: bool = False) -> DeploymentHandle:
+    """Deploy an application (reference: serve/api.py:691 serve.run).
+    _local_testing_mode=True builds the graph in-process with no cluster
+    (reference: serve/_private/local_testing_mode.py)."""
+    if _local_testing_mode:
+        from ray_tpu.serve._local import run_local
+
+        return run_local(target)  # type: ignore[return-value]
     controller = start()
     apps = _flatten(target)
     # Deploy children first so parents find their handles live.

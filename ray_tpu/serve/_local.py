@@ -1,0 +1,97 @@
+"""Local testing mode: run a deployment graph in-process, no cluster
+(reference: serve/_private/local_testing_mode.py — used by unit tests and
+notebooks to exercise deployment logic without actors/proxies).
+
+serve.run(app, _local_testing_mode=True) builds every deployment's callable
+inline and returns a handle whose .remote() calls it synchronously on a
+thread, wrapped in the same DeploymentResponse-shaped future the real
+handle returns."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import inspect
+from typing import Any, Dict, Optional, Tuple
+
+
+class LocalDeploymentResponse:
+    def __init__(self, fut: "concurrent.futures.Future"):
+        self._fut = fut
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        return self._fut.result(timeout)
+
+    @property
+    def ref(self):
+        raise RuntimeError("local testing mode has no ObjectRefs")
+
+
+class LocalHandle:
+    """DeploymentHandle lookalike over an in-process callable."""
+
+    def __init__(self, callable_obj: Any, method_name: str = "__call__"):
+        self._callable = callable_obj
+        self._method_name = method_name
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None) -> "LocalHandle":
+        h = LocalHandle(self._callable,
+                        method_name or self._method_name)
+        h._pool = self._pool
+        h._multiplexed_model_id = multiplexed_model_id or getattr(
+            self, "_multiplexed_model_id", None)
+        return h
+
+    def __getattr__(self, name: str) -> "LocalHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> LocalDeploymentResponse:
+        fn = (self._callable if self._method_name == "__call__"
+              and not inspect.isclass(self._callable)
+              and not hasattr(self._callable, self._method_name)
+              else getattr(self._callable, self._method_name, self._callable))
+
+        def run():
+            mid = getattr(self, "_multiplexed_model_id", None)
+            if mid:
+                from ray_tpu.serve.multiplex import _set_current_model_id
+
+                token = _set_current_model_id(mid)
+                try:
+                    return fn(*args, **kwargs)
+                finally:
+                    from ray_tpu.serve.multiplex import _current_model_id
+
+                    _current_model_id.reset(token)
+            return fn(*args, **kwargs)
+
+        return LocalDeploymentResponse(self._pool.submit(run))
+
+
+def run_local(target) -> LocalHandle:
+    """Build the whole bound graph in-process; child deployments become
+    LocalHandles injected as init args, mirroring serve.run's wiring."""
+    from ray_tpu.serve import Application
+
+    def build(app: Application):
+        dep = app.deployment
+        args = tuple(build(a) if isinstance(a, Application) else a
+                     for a in app.args)
+        kwargs = {k: build(v) if isinstance(v, Application) else v
+                  for k, v in app.kwargs.items()}
+        ctor = dep._ctor
+        if inspect.isclass(ctor):
+            inst = ctor(*args, **kwargs)
+        else:
+            inst = ctor
+        if dep.user_config is not None:
+            reconfigure = getattr(inst, "reconfigure", None)
+            if callable(reconfigure):
+                reconfigure(dep.user_config)
+        return LocalHandle(inst)
+
+    return build(target)

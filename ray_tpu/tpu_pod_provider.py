@@ -93,6 +93,8 @@ class TPUPodNodeProvider(NodeProvider):
                 logger.info("TPU slice %s landed after cancellation; "
                             "tearing down", name)
                 self.transport.delete_queued_resource(name, backings)
+                with self._lock:
+                    self._cancelled.discard(name)  # teardown done
                 return
             logger.info("TPU slice %s ACTIVE (%d hosts)", name, len(hosts))
 

@@ -7,7 +7,7 @@ Layers:
 - config.py/_checkpoint.py: configs and directory checkpoints.
 """
 
-from ray_tpu.train._checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train._checkpoint import (Checkpoint, CheckpointManager, load_pytree, save_pytree)
 from ray_tpu.train.config import (
     CheckpointConfig,
     FailureConfig,
@@ -19,6 +19,7 @@ from ray_tpu.train.trainer import (
     DataParallelTrainer,
     JaxTrainer,
     Result,
+    TorchTrainer,
     TrainingFailedError,
 )
 
@@ -32,10 +33,13 @@ __all__ = [
     "Result",
     "RunConfig",
     "ScalingConfig",
+    "TorchTrainer",
     "TrainingFailedError",
     "get_context",
     "get_dataset_shard",
+    "load_pytree",
     "report",
+    "save_pytree",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rec

@@ -106,3 +106,33 @@ class CheckpointManager:
         if not scored:
             return self.latest
         return Checkpoint(max(scored, key=lambda e: sign * e[0])[2])
+
+
+# ---------------------------------------------------------------------------
+# Orbax integration (reference: the torch trainers save torch state dicts;
+# the TPU-idiomatic checkpoint format for jax pytrees is orbax —
+# train/_checkpoint keeps the directory contract, orbax fills it).
+# ---------------------------------------------------------------------------
+def save_pytree(pytree, path: str) -> "Checkpoint":
+    """Write a jax pytree (params / train state) into `path` with orbax and
+    return a Checkpoint over it. Pairs with `load_pytree`."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if os.path.isdir(path):
+        shutil.rmtree(path, ignore_errors=True)
+    ckpt = ocp.PyTreeCheckpointer()
+    ckpt.save(path, pytree)
+    return Checkpoint(path)
+
+
+def load_pytree(checkpoint: "Checkpoint", target=None):
+    """Restore the pytree from an orbax-written Checkpoint. `target` (an
+    example pytree) restores concrete array types/shardings; None returns
+    the raw restored tree."""
+    import orbax.checkpoint as ocp
+
+    ckpt = ocp.PyTreeCheckpointer()
+    if target is not None:
+        return ckpt.restore(checkpoint.as_directory(), item=target)
+    return ckpt.restore(checkpoint.as_directory())

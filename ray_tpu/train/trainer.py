@@ -136,7 +136,7 @@ class DataParallelTrainer:
         )
         try:
             backend_config: Dict[str, Any] = {"kind": self.backend}
-            if self.backend == "jax" and num_workers > 1:
+            if self.backend in ("jax", "torch") and num_workers > 1:
                 # The coordinator binds on worker 0's HOST — pick the free
                 # port there, not on the driver (different machines in
                 # multi-host clusters).
@@ -210,3 +210,15 @@ class DataParallelTrainer:
 # The reference exposes framework-specific trainers (TorchTrainer); the
 # native TPU analog is a thin alias.
 JaxTrainer = DataParallelTrainer
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer with a torch.distributed (gloo) process group
+    (reference: train/torch/torch_trainer.py + config.py:153). The jax
+    backend is the TPU path; this exists for CPU-side torch workloads and
+    for porting parity — the same train_loop_per_worker/report/checkpoint
+    surface, with `torch.distributed` collectives instead of a mesh."""
+
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("backend", "torch")
+        super().__init__(*args, **kwargs)

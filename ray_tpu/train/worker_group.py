@@ -58,19 +58,31 @@ class _TrainWorker:
         return os.environ.get("RAY_TPU_NODE_ID", "")
 
     def setup_backend(self, backend_config: Dict[str, Any]) -> None:
-        """Initialize the distributed compute plane (jax.distributed) before
-        the training fn starts."""
-        if backend_config.get("kind") != "jax":
-            return
+        """Initialize the distributed compute plane before the training fn
+        starts: jax.distributed for the TPU path; torch.distributed (gloo)
+        for CPU-side torch parity (reference: _TorchBackend
+        _setup_torch_process_group, train/torch/config.py:153)."""
+        kind = backend_config.get("kind")
         if self.world_size <= 1 or not backend_config.get("coordinator"):
             return
-        import jax
+        if kind == "jax":
+            import jax
 
-        jax.distributed.initialize(
-            coordinator_address=backend_config["coordinator"],
-            num_processes=self.world_size,
-            process_id=self.rank,
-        )
+            jax.distributed.initialize(
+                coordinator_address=backend_config["coordinator"],
+                num_processes=self.world_size,
+                process_id=self.rank,
+            )
+        elif kind == "torch":
+            import torch.distributed as dist
+
+            if not dist.is_initialized():
+                dist.init_process_group(
+                    backend=backend_config.get("torch_backend", "gloo"),
+                    init_method=f"tcp://{backend_config['coordinator']}",
+                    rank=self.rank,
+                    world_size=self.world_size,
+                )
 
     def start_training(self, train_fn_ref, config: Dict[str, Any],
                        checkpoint: Optional[Checkpoint],

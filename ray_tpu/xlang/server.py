@@ -151,11 +151,16 @@ class XlangServer:
                 raise TypeError("xlang actor method must return bytes")
             return bytes(out)
         if op == OP_RELEASE:
-            # Clients must release refs they are done with: the server pins
-            # them on the client's behalf (util/client.py has the same
-            # contract via client_release), and a leak here is unbounded
-            # store growth.
-            _pins.pop(body.decode(), None)
+            # Clients must release refs AND actors they are done with: the
+            # server pins both on the client's behalf (util/client.py has
+            # the same contract via client_release), and a leak here is
+            # unbounded store/actor growth.
+            hexid = body.decode()
+            _pins.pop(hexid, None)
+            handle = self._actors.pop(hexid, None)
+            if handle is not None:
+                await loop.run_in_executor(
+                    None, lambda: ray_tpu.kill(handle))
             return b"ok"
         raise ValueError(f"unknown xlang op {op}")
 

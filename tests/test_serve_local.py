@@ -1,0 +1,52 @@
+"""serve local testing mode — deployment graphs without a cluster
+(reference: serve/_private/local_testing_mode.py). No ray_cluster fixture
+on purpose: the whole point is no cluster."""
+
+from ray_tpu import serve
+
+
+def test_local_mode_simple_class():
+    @serve.deployment
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+        def describe(self):
+            return "doubler"
+
+    h = serve.run(Doubler.bind(), _local_testing_mode=True)
+    assert h.remote(21).result(timeout=10) == 42
+    assert h.describe.remote().result(timeout=10) == "doubler"
+
+
+def test_local_mode_composed_graph():
+    @serve.deployment
+    class Inner:
+        def __call__(self, x):
+            return x + 1
+
+    @serve.deployment
+    class Outer:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __call__(self, x):
+            return self.inner.remote(x).result(timeout=10) * 10
+
+    h = serve.run(Outer.bind(Inner.bind()), _local_testing_mode=True)
+    assert h.remote(4).result(timeout=10) == 50
+
+
+def test_local_mode_multiplex_context():
+    @serve.deployment
+    class Host:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, mid):
+            return f"m:{mid}"
+
+        def __call__(self, _x):
+            return self.get_model(serve.get_multiplexed_model_id())
+
+    h = serve.run(Host.bind(), _local_testing_mode=True)
+    out = h.options(multiplexed_model_id="z9").remote(0).result(timeout=10)
+    assert out == "m:z9"
