@@ -709,23 +709,29 @@ class Worker:
     # Task events / timeline (reference: task_event_buffer.h ->
     # GcsTaskManager -> `ray timeline` chrome trace)
     # ------------------------------------------------------------------
-    def record_task_event(self, spec: TaskSpec, start_ts: float,
-                          end_ts: float, ok: bool) -> None:
+    def record_event(self, event: Dict[str, Any]) -> None:
+        """Append one event to the task-event buffer and make sure the
+        flusher runs. Used by task execution AND user tracing spans
+        (util/tracing.py) — the single entry point to the pipeline."""
+        event.setdefault("pid", os.getpid())
+        event.setdefault("node_id", self.node_id.hex())
         with self._task_events_lock:
-            self._task_events.append({
-                "task_id": spec.task_id.hex(),
-                "name": spec.function_name,
-                "type": spec.task_type.name,
-                "pid": os.getpid(),
-                "node_id": self.node_id.hex(),
-                "start_ts": start_ts,
-                "end_ts": end_ts,
-                "ok": ok,
-            })
+            self._task_events.append(event)
             if not self._task_events_flusher_started:
                 self._task_events_flusher_started = True
                 self.loop.call_soon_threadsafe(
                     lambda: asyncio.ensure_future(self._task_event_loop()))
+
+    def record_task_event(self, spec: TaskSpec, start_ts: float,
+                          end_ts: float, ok: bool) -> None:
+        self.record_event({
+            "task_id": spec.task_id.hex(),
+            "name": spec.function_name,
+            "type": spec.task_type.name,
+            "start_ts": start_ts,
+            "end_ts": end_ts,
+            "ok": ok,
+        })
 
     async def _task_event_loop(self) -> None:
         while not self._shutdown:

@@ -506,27 +506,6 @@ class Nodelet:
             },
         }
 
-    async def rpc_fetch_object(self, object_id: bytes) -> Optional[Dict[str, Any]]:
-        """Serve a sealed object from this node's store to a remote puller
-        (reference: ObjectManager Push/Pull, object_manager.proto:60 — here a
-        single framed reply; the rpc layer ships buffers out-of-band)."""
-        from ray_tpu._private.ids import ObjectID
-
-        oid = ObjectID(object_id)
-        obj = self.store.get_serialized(oid)
-        if obj is None:
-            from ray_tpu.core.object_store import spill_read
-
-            obj = spill_read(os.path.join(
-                self.session_dir, "spill", self.node_id.hex()), oid)
-        if obj is None:
-            return None
-        # The read pin auto-releases when obj's buffers are dropped.
-        return {
-            "metadata": bytes(obj.metadata),
-            "buffers": [bytes(b) for b in obj.buffers],
-        }
-
     def _read_object_for_transfer(self, object_id: bytes):
         """Sealed object lookup (shm, then spill) shared by the whole-object
         and chunked fetch paths. Shm reads are cheap memoryviews; a SPILLED

@@ -35,6 +35,17 @@ class DAGNode:
     def __init__(self, args: Tuple = (), kwargs: Optional[Dict] = None):
         self.args = args
         self.kwargs = kwargs or {}
+        self._tensor_transport: str = ""
+
+    def with_tensor_transport(self, transport: str = "device") -> "DAGNode":
+        """Mark this stage's OUTPUT to travel on the device-object plane:
+        jax.Arrays stay in the producing actor's HBM and move to the
+        consuming stage without a host pickle round trip (reference: aDAG
+        `with_tensor_transport` / TorchTensorType NCCL channels,
+        experimental/channel/torch_tensor_nccl_channel.py — here the
+        transport is experimental/device_objects.py)."""
+        self._tensor_transport = transport
+        return self
 
     def experimental_compile(self, **_opts) -> "CompiledDAG":
         return CompiledDAG(self)
@@ -126,6 +137,9 @@ class CompiledDAG:
             args = tuple(resolve(a) for a in node.args)
             kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
             method = getattr(node.actor_handle, node.method_name)
+            if node._tensor_transport:
+                method = method.options(
+                    tensor_transport=node._tensor_transport)
             results[id(node)] = method.remote(*args, **kwargs)
 
         out = self._output

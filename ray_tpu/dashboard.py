@@ -54,10 +54,12 @@ class DashboardActor:
                 h = await reader.readline()
                 if h in (b"\r\n", b"", b"\n"):
                     break
-            status, body = await self._route(path)
+            out = await self._route(path)
+            status, body = out[0], out[1]
+            ctype = out[2] if len(out) > 2 else "application/json"
             writer.write(
                 b"HTTP/1.1 " + str(status).encode() + b" X\r\n"
-                b"content-type: application/json\r\n"
+                b"content-type: " + ctype.encode() + b"\r\n"
                 b"content-length: " + str(len(body)).encode() +
                 b"\r\nconnection: close\r\n\r\n" + body)
             await writer.drain()
@@ -75,6 +77,8 @@ class DashboardActor:
         loop = asyncio.get_running_loop()
         if path == "/healthz":
             return 200, b'"ok"'
+        if path == "/" or path == "/index.html":
+            return 200, _INDEX_HTML, "text/html"
         if path.rstrip("/") == "/metrics":
             # Prometheus text exposition (reference: the per-node metrics
             # agent + prometheus_exporter.py; single scrape endpoint here).
@@ -82,7 +86,7 @@ class DashboardActor:
 
             try:
                 text = await loop.run_in_executor(None, prometheus_text)
-                return 200, text.encode()
+                return 200, text.encode(), "text/plain; version=0.0.4"
             except Exception as e:
                 logger.exception("metrics exposition failed")
                 return 500, json.dumps({"error": str(e)}).encode()
@@ -106,6 +110,38 @@ class DashboardActor:
         except Exception as e:
             logger.exception("dashboard route %s failed", path)
             return 500, json.dumps({"error": str(e)}).encode()
+
+
+_INDEX_HTML = b"""<!doctype html>
+<html><head><title>ray_tpu dashboard</title><style>
+body{font-family:monospace;margin:2em;background:#111;color:#ddd}
+h1{color:#8cf} td,th{padding:4px 12px;text-align:left}
+a{color:#8cf} .num{color:#fc8;font-size:1.4em}
+section{margin-bottom:1.5em}</style></head><body>
+<h1>ray_tpu</h1>
+<section id="summary">loading&hellip;</section>
+<section><table id="nodes"></table></section>
+<section>endpoints:
+<a href="/api/summary">summary</a> <a href="/api/nodes">nodes</a>
+<a href="/api/actors">actors</a> <a href="/api/workers">workers</a>
+<a href="/api/jobs">jobs</a> <a href="/api/placement_groups">pgs</a>
+<a href="/api/tasks">tasks</a> <a href="/metrics">metrics</a></section>
+<script>
+async function refresh(){
+ const s=await (await fetch('/api/summary')).json();
+ document.getElementById('summary').innerHTML=
+  `<span class=num>${s.nodes_alive}</span> nodes &nbsp;`+
+  `<span class=num>${s.actors_alive??'-'}</span> actors &nbsp;`+
+  `<span class=num>${JSON.stringify(s.resources_total??{})}</span>`;
+ const nodes=await (await fetch('/api/nodes')).json();
+ document.getElementById('nodes').innerHTML=
+  '<tr><th>node</th><th>alive</th><th>resources</th></tr>'+
+  nodes.map(n=>`<tr><td>${(n.labels&&n.labels.node_name)||n.node_id.slice(0,10)}</td>`+
+   `<td>${n.alive}</td><td>${JSON.stringify(n.resources_available)}</td></tr>`).join('');
+}
+refresh(); setInterval(refresh, 5000);
+</script></body></html>
+"""
 
 
 def _jsonable(o):

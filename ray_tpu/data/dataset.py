@@ -57,10 +57,15 @@ class _Source:
 
 @dataclasses.dataclass
 class _RefSource:
-    """Blocks already in the object store (materialized datasets)."""
+    """Blocks already in the object store (materialized datasets), or a
+    thunk producing their refs on first consumption (lazy all-to-all ops
+    like hash_shuffle)."""
 
-    refs: List[Any]
+    refs: Any  # List[ObjectRef] | Callable[[], List[ObjectRef]]
     name: str = "RefSource"
+
+    def resolve_refs(self) -> List[Any]:
+        return self.refs() if callable(self.refs) else self.refs
 
 
 @dataclasses.dataclass
@@ -139,7 +144,7 @@ def _exec_stream(plan: List[Any]) -> Iterator[Any]:
     plan = _fuse_plan(plan)
     src = plan[0]
     if isinstance(src, _RefSource):
-        stream: Iterator[Any] = iter(src.refs)
+        stream: Iterator[Any] = iter(src.resolve_refs())
     else:
         stream = (ray_tpu.put(b) for b in src.make_blocks())
 
@@ -371,7 +376,7 @@ class Dataset:
     def count(self) -> int:
         if isinstance(self._plan[0], _RefSource) and len(self._plan) == 1:
             return sum(ray_tpu.get(_remote_num_rows().remote(r))
-                       for r in self._plan[0].refs)
+                       for r in self._plan[0].resolve_refs())
         return sum(block_num_rows(b) for b in self.iter_blocks())
 
     def schema(self) -> Optional[Dict[str, Any]]:
@@ -430,8 +435,57 @@ class Dataset:
 
         return Dataset([_Source(gen, name="Sort")])
 
-    def groupby(self, key: str) -> "GroupedData":
+    def groupby(self, key: str, *,
+                num_partitions: Optional[int] = None) -> "GroupedData":
+        """num_partitions=None aggregates driver-side (right at single-host
+        block counts); num_partitions=P runs a distributed hash shuffle
+        (reference: _internal/execution/operators/hash_shuffle.py) so each
+        of P reduce blocks holds COMPLETE groups — aggregations then run as
+        per-block tasks with no driver materialization."""
+        if num_partitions:
+            return GroupedData(self.hash_shuffle(key, num_partitions), key,
+                               pre_partitioned=True)
         return GroupedData(self, key)
+
+    def hash_shuffle(self, key: str, num_partitions: int) -> "Dataset":
+        """All-to-all: partition every block by a stable hash of `key`,
+        merge partition p across blocks into one output block. Map and
+        reduce are cluster tasks; the driver only routes refs (reference:
+        hash shuffle map/reduce tasks, operators/hash_shuffle.py). Lazy
+        like every other operator: the shuffle submits when the result is
+        first consumed."""
+        P = max(1, int(num_partitions))
+        plan = list(self._plan)
+
+        def run_shuffle() -> List[Any]:
+            upstream = list(_exec_stream(plan))
+
+            @ray_tpu.remote
+            def _merge(*blocks: Block) -> Block:
+                nonempty = [b for b in blocks if block_num_rows(b)]
+                return block_concat(nonempty) if nonempty else {}
+
+            if P == 1:
+                # Degenerate shuffle: everything lands in one partition —
+                # no map stage needed (num_returns=1 would hand _merge a
+                # 1-tuple, not a block).
+                return [_merge.remote(*upstream)]
+
+            @ray_tpu.remote
+            def _partition(block: Block, key=key, P=P):
+                vals = block[key]
+                codes = _stable_hash_codes(vals, P)
+                return tuple(
+                    {k: np.asarray(v)[codes == p]
+                     for k, v in block.items()}
+                    for p in _range(P))
+
+            rows = [_partition.options(num_returns=P).remote(u)
+                    for u in upstream]
+            return [_merge.remote(*[row[p] for row in rows])
+                    for p in _range(P)]
+
+        return Dataset([_RefSource(run_shuffle, name="HashShuffle")])
 
     def split(self, n: int) -> List["Dataset"]:
         refs = list(self.iter_block_refs())
@@ -528,14 +582,29 @@ class Dataset:
         return f"Dataset(plan={self.stats()})"
 
 
-class GroupedData:
-    """Groupby aggregations (reference: data/grouped_data.py — there a hash
-    shuffle over tasks; here a driver-side composition over the streamed
-    blocks, which is the right call at single-host block counts)."""
+def _stable_hash_codes(vals, P: int) -> np.ndarray:
+    """Partition codes that are identical in EVERY worker process —
+    builtin hash() is per-process seed-randomized and would scatter one
+    key across partitions."""
+    import zlib
 
-    def __init__(self, ds: Dataset, key: str):
+    arr = np.asarray(vals)
+    if arr.dtype.kind in "iub":
+        return (arr.astype(np.int64) % P).astype(np.int64)
+    return np.array(
+        [zlib.crc32(repr(x).encode()) % P for x in arr], np.int64)
+
+
+class GroupedData:
+    """Groupby aggregations (reference: data/grouped_data.py). Driver-side
+    composition by default; with pre_partitioned=True (hash_shuffle ran
+    first, so every block holds complete groups) the aggregation itself is
+    a per-block cluster task."""
+
+    def __init__(self, ds: Dataset, key: str, pre_partitioned: bool = False):
         self._ds = ds
         self._key = key
+        self._pre_partitioned = pre_partitioned
 
     def _gather(self):
         full = block_concat(list(self._ds.iter_blocks()))
@@ -544,6 +613,27 @@ class GroupedData:
         return full, uniq, inv
 
     def _agg(self, fn, cols: Optional[Sequence[str]], suffix: str) -> Dataset:
+        if self._pre_partitioned:
+            # Complete groups per block → aggregation is a per-block TASK.
+            key = self._key
+
+            def agg_block(block, key=key, fn=fn, cols=cols, suffix=suffix):
+                if not block_num_rows(block):
+                    return {}
+                keys = np.asarray(block[key])
+                uniq, inv = np.unique(keys, return_inverse=True)
+                use = [c for c in (cols or block.keys()) if c != key]
+                out = {key: uniq}
+                for c in use:
+                    vals = np.asarray(block[c])
+                    # NB: _range — this module shadows builtin range with
+                    # the Dataset factory.
+                    out[f"{c}_{suffix}"] = np.asarray(
+                        [fn(vals[inv == g]) for g in _range(len(uniq))])
+                return out
+
+            return Dataset(self._ds._plan + [_MapBatches(
+                agg_block, batch_size=None, name=f"GroupAgg({suffix})")])
         full, uniq, inv = self._gather()
         cols = [c for c in (cols or full.keys()) if c != self._key]
         out: Dict[str, np.ndarray] = {self._key: uniq}
@@ -554,6 +644,19 @@ class GroupedData:
         return from_items(block_to_items(out))
 
     def count(self) -> Dataset:
+        if self._pre_partitioned:
+            key = self._key
+
+            def count_block(block, key=key):
+                if not block_num_rows(block):
+                    return {}
+                keys = np.asarray(block[key])
+                uniq, inv = np.unique(keys, return_inverse=True)
+                return {key: uniq,
+                        "count": np.bincount(inv, minlength=len(uniq))}
+
+            return Dataset(self._ds._plan + [_MapBatches(
+                count_block, batch_size=None, name="GroupCount")])
         full, uniq, inv = self._gather()
         counts = np.bincount(inv, minlength=len(uniq))
         return from_items(block_to_items(

@@ -32,6 +32,36 @@ class IMPALALearnerConfig:
     max_grad_norm: float = 40.0
 
 
+def vtrace_targets(values, next_value, rewards, dones, rhos, *,
+                   gamma: float, rho_clip: float = 1.0, c_clip: float = 1.0):
+    """V-trace targets vs and policy-gradient advantages over [T, N]
+    trajectories (reference: IMPALA paper eq. 1; rllib vtrace). Module-level
+    so the recursion the learner jits IS the one the tests exercise."""
+    import jax
+    import jax.numpy as jnp
+
+    rho_bar = jnp.minimum(rhos, rho_clip)
+    c_bar = jnp.minimum(rhos, c_clip)
+    nonterm = 1.0 - dones
+    # values_{t+1}: shift; bootstrap with next_value at the end.
+    values_tp1 = jnp.concatenate([values[1:], next_value[None]], axis=0)
+    deltas = rho_bar * (rewards + gamma * nonterm * values_tp1 - values)
+
+    def step(carry, xs):
+        delta, c, nt = xs
+        acc = delta + gamma * nt * c * carry
+        return acc, acc
+
+    _, acc = jax.lax.scan(
+        step, jnp.zeros_like(next_value), (deltas, c_bar, nonterm),
+        reverse=True)
+    vs = values + acc
+    vs_tp1 = jnp.concatenate([vs[1:], next_value[None]], axis=0)
+    # Policy-gradient advantage uses the V-trace targets.
+    pg_adv = rho_bar * (rewards + gamma * nonterm * vs_tp1 - values)
+    return vs, pg_adv
+
+
 class IMPALALearner:
     """Jitted V-trace actor-critic update over [T, N] trajectories."""
 
@@ -51,33 +81,6 @@ class IMPALALearner:
         net = module.net
         cfg = config
 
-        def vtrace(values, next_value, rewards, dones, rhos):
-            """V-trace targets vs (scan from the end; reference:
-            IMPALA paper eq. 1, rllib vtrace_jax-equivalent)."""
-            rho_bar = jnp.minimum(rhos, cfg.rho_clip)
-            c_bar = jnp.minimum(rhos, cfg.c_clip)
-            nonterm = 1.0 - dones
-            # values_{t+1}: shift; bootstrap with next_value at the end.
-            values_tp1 = jnp.concatenate(
-                [values[1:], next_value[None]], axis=0)
-            deltas = rho_bar * (
-                rewards + cfg.gamma * nonterm * values_tp1 - values)
-
-            def step(carry, xs):
-                delta, c, nt = xs
-                acc = delta + cfg.gamma * nt * c * carry
-                return acc, acc
-
-            _, acc = jax.lax.scan(
-                step, jnp.zeros_like(next_value),
-                (deltas, c_bar, nonterm), reverse=True)
-            vs = values + acc
-            vs_tp1 = jnp.concatenate([vs[1:], next_value[None]], axis=0)
-            # Policy-gradient advantage uses the V-trace targets.
-            pg_adv = rho_bar * (
-                rewards + cfg.gamma * nonterm * vs_tp1 - values)
-            return vs, pg_adv
-
         def loss_fn(params, batch):
             T, N = batch["actions"].shape
             obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
@@ -88,10 +91,11 @@ class IMPALALearner:
             logp = jnp.take_along_axis(
                 logp_all, batch["actions"][..., None], axis=-1)[..., 0]
             rhos = jnp.exp(logp - batch["behavior_logp"])
-            vs, pg_adv = vtrace(
+            vs, pg_adv = vtrace_targets(
                 jax.lax.stop_gradient(values), batch["next_value"],
                 batch["rewards"], batch["dones"],
-                jax.lax.stop_gradient(rhos))
+                jax.lax.stop_gradient(rhos),
+                gamma=cfg.gamma, rho_clip=cfg.rho_clip, c_clip=cfg.c_clip)
             pg_loss = -jnp.mean(logp * jax.lax.stop_gradient(pg_adv))
             vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
             entropy = -jnp.mean(jnp.sum(

@@ -50,10 +50,12 @@ class LocalHandle:
         return self.options(method_name=name)
 
     def remote(self, *args, **kwargs) -> LocalDeploymentResponse:
-        fn = (self._callable if self._method_name == "__call__"
-              and not inspect.isclass(self._callable)
-              and not hasattr(self._callable, self._method_name)
-              else getattr(self._callable, self._method_name, self._callable))
+        if self._method_name == "__call__":
+            fn = self._callable  # instance __call__ or function deployment
+        else:
+            # A typo'd method must fail like the real handle would — no
+            # silent fallback to the deployment itself.
+            fn = getattr(self._callable, self._method_name)
 
         def run():
             mid = getattr(self, "_multiplexed_model_id", None)

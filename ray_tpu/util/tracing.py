@@ -1,0 +1,54 @@
+"""User-level tracing spans (reference: util/tracing/tracing_helper.py —
+OpenTelemetry spans around submit/execute; here spans ride the existing
+task-event pipeline, so `ray_tpu timeline` renders user spans next to the
+runtime's task rows in the same chrome://tracing view).
+
+    with ray_tpu.util.tracing.span("tokenize"):
+        ...                      # inside a task, an actor method, or driver
+
+Spans nest via a contextvar; each records (name, parent, start, end) into
+the process's task-event buffer and flushes with it."""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import time
+import uuid
+from typing import Iterator, Optional
+
+_current_span: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("ray_tpu_span", default=None)
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes) -> Iterator[str]:
+    """Record one timed span; yields the span id (usable as an explicit
+    parent for cross-process continuation)."""
+    from ray_tpu._private import worker as worker_mod
+
+    span_id = uuid.uuid4().hex[:16]
+    parent = _current_span.get()
+    token = _current_span.set(span_id)
+    start = time.time()
+    try:
+        yield span_id
+    finally:
+        end = time.time()
+        _current_span.reset(token)
+        w = worker_mod.global_worker_or_none()
+        if w is not None:
+            w.record_event({
+                "task_id": span_id,
+                "name": f"span:{name}",
+                "type": "USER_SPAN",
+                "parent": parent,
+                "attributes": {k: str(v) for k, v in attributes.items()},
+                "start_ts": start,
+                "end_ts": end,
+                "ok": True,
+            })
+
+
+def current_span_id() -> Optional[str]:
+    return _current_span.get()

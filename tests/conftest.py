@@ -36,3 +36,31 @@ def ray_cluster():
 @pytest.fixture
 def ray_start_regular(ray_cluster):
     return ray_cluster
+
+
+# ---------------------------------------------------------------------------
+# Per-test watchdog (reference: pytest.ini's 180s default per-test timeout).
+# No pytest-timeout in this image, so a SIGALRM in the main thread turns a
+# hung test into a failure with a traceback instead of wedging the suite.
+# ---------------------------------------------------------------------------
+TEST_TIMEOUT_S = 600
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    import faulthandler
+    import signal
+    import sys
+
+    def _alarm(signum, frame):
+        faulthandler.dump_traceback(file=sys.stderr)
+        raise TimeoutError(
+            f"test exceeded {TEST_TIMEOUT_S}s (per-test watchdog)")
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

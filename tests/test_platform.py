@@ -35,6 +35,13 @@ def test_dashboard_endpoints(ray_start_regular):
     nodes = fetch("/api/nodes")
     assert nodes and nodes[0]["alive"]
 
+    # HTML index (the dashboard UI floor).
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=60) as r:
+        assert "text/html" in r.headers.get("content-type", "")
+        page = r.read().decode()
+    assert "ray_tpu" in page and "/api/summary" in page
+
     # Prometheus exposition (reference: prometheus_exporter.py).
     from ray_tpu.util import metrics as um
 

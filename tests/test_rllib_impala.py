@@ -39,30 +39,15 @@ def test_vtrace_matches_python_reference():
         acc = deltas[t] + cfg.gamma * nonterm[t] * c_bar[t] * acc
         vs_ref[t] = values[t] + acc
 
-    # Pull the jitted vtrace via the loss closure's inner function by
-    # reconstructing it the same way (the recursion is deterministic).
-    import jax
+    # Exercise THE function the learner jits (module-level
+    # vtrace_targets), not a reconstructed copy.
+    from ray_tpu.rllib.impala import vtrace_targets
 
-    def vtrace(values, next_value, rewards, dones, rhos):
-        rho_b = jnp.minimum(rhos, cfg.rho_clip)
-        c_b = jnp.minimum(rhos, cfg.c_clip)
-        nt = 1.0 - dones
-        v_tp1 = jnp.concatenate([values[1:], next_value[None]], axis=0)
-        deltas = rho_b * (rewards + cfg.gamma * nt * v_tp1 - values)
-
-        def step(carry, xs):
-            delta, c, n = xs
-            a = delta + cfg.gamma * n * c * carry
-            return a, a
-
-        _, accs = jax.lax.scan(step, jnp.zeros_like(next_value),
-                               (deltas, c_b, nt), reverse=True)
-        return values + accs
-
-    vs = np.asarray(vtrace(jnp.asarray(values), jnp.asarray(next_value),
-                           jnp.asarray(rewards), jnp.asarray(dones),
-                           jnp.asarray(rhos)))
-    np.testing.assert_allclose(vs, vs_ref, rtol=1e-5, atol=1e-5)
+    vs, _pg_adv = vtrace_targets(
+        jnp.asarray(values), jnp.asarray(next_value), jnp.asarray(rewards),
+        jnp.asarray(dones), jnp.asarray(rhos),
+        gamma=cfg.gamma, rho_clip=cfg.rho_clip, c_clip=cfg.c_clip)
+    np.testing.assert_allclose(np.asarray(vs), vs_ref, rtol=1e-5, atol=1e-5)
     assert learner is not None  # constructed fine
 
 

@@ -1,0 +1,39 @@
+"""User tracing spans riding the task-event pipeline (reference:
+util/tracing/tracing_helper.py OpenTelemetry spans; here spans land in the
+same timeline as task rows)."""
+
+import time as _t
+
+import ray_tpu
+from ray_tpu.util import state, tracing
+
+
+def test_spans_nest_and_reach_timeline(ray_start_regular, tmp_path):
+    with tracing.span("outer", stage="prep") as outer_id:
+        assert tracing.current_span_id() == outer_id
+        with tracing.span("inner") as inner_id:
+            assert inner_id != outer_id
+    assert tracing.current_span_id() is None
+
+    # A span recorded INSIDE a task on a worker process.
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.util import tracing as tr
+
+        with tr.span("in-task"):
+            return 1
+
+    assert ray_tpu.get(work.remote()) == 1
+
+    deadline = _t.time() + 15
+    names = set()
+    while _t.time() < deadline:
+        names = {t["name"] for t in state.list_tasks()
+                 if t["name"].startswith("span:")}
+        if {"span:outer", "span:inner", "span:in-task"} <= names:
+            break
+        _t.sleep(0.5)
+    assert {"span:outer", "span:inner", "span:in-task"} <= names
+    spans = [t for t in state.list_tasks()
+             if t["name"] == "span:inner"]
+    assert spans and spans[0].get("parent")  # nested under outer
