@@ -59,7 +59,25 @@ def detect_resources(num_cpus: Optional[float] = None,
             # scheduling (reference: tpu.py pod-slice naming).
             if os.environ.get("TPU_WORKER_ID", "0") == "0":
                 resources[f"TPU-{gen}-{topo}-head"] = 1.0
+    # Schedulable memory (reference: ray gives tasks/actors a `memory`
+    # resource for admission control — enforcement is the memory monitor's
+    # OOM policy, not a hard cap). 70% of MemTotal, like the reference's
+    # default memory headroom.
+    mem = _host_memory_bytes()
+    if mem:
+        resources["memory"] = float(int(mem * 0.7))
     return resources
+
+
+def _host_memory_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
 
 
 def _probe_jax_tpus() -> int:

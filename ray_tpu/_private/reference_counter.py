@@ -139,3 +139,23 @@ class ReferenceCounter:
                 "records": len(self._records),
                 "owned": sum(1 for r in self._records.values() if r.owned),
             }
+
+    def summary(self) -> dict:
+        """Ref-count debugging view (reference: `ray memory` — per-object
+        local counts, ownership, borrowers)."""
+        with self._lock:
+            owned = borrowed = 0
+            entries = []
+            for oid, rec in self._records.items():
+                if rec.owned:
+                    owned += 1
+                else:
+                    borrowed += 1
+                entries.append({
+                    "object_id": oid.hex(),
+                    "owned": rec.owned,
+                    "local_refs": rec.local,
+                    "borrowers": len(getattr(rec, "borrowers", ()) or ()),
+                })
+            return {"owned": owned, "borrowed": borrowed,
+                    "entries": entries}

@@ -121,6 +121,8 @@ class ActorClass:
         resources.setdefault("CPU", 0.0 if num_cpus is None else float(num_cpus))
         if num_tpus:
             resources["TPU"] = float(num_tpus)
+        if opts.get("memory"):
+            resources["memory"] = float(opts["memory"])
         lifetime = opts.get("lifetime")
         if opts.get("get_if_exists") and not opts.get("name"):
             raise ValueError("get_if_exists=True requires a `name`")

@@ -41,6 +41,8 @@ class RemoteFunction:
         resources.setdefault("CPU", 1.0 if num_cpus is None else float(num_cpus))
         if num_tpus:
             resources["TPU"] = float(num_tpus)
+        if opts.get("memory"):
+            resources["memory"] = float(opts["memory"])
         num_returns = opts.get("num_returns", 1)
         if num_returns == "dynamic":
             num_returns = -1  # streaming generator (see _private/generators)

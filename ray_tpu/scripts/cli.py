@@ -17,7 +17,7 @@ def main() -> None:
                         help="GCS address host:port of a running cluster")
     parser.add_argument("command", choices=[
         "status", "nodes", "actors", "workers", "jobs", "placement-groups",
-        "tasks", "timeline"])
+        "tasks", "timeline", "memory", "metrics"])
     args = parser.parse_args()
 
     import ray_tpu
@@ -39,6 +39,12 @@ def main() -> None:
             out = state.list_tasks()
         elif args.command == "timeline":
             out = {"written": state.timeline("timeline.json")}
+        elif args.command == "memory":
+            out = state.memory_summary()
+        elif args.command == "metrics":
+            from ray_tpu.util.metrics import query_metrics
+
+            out = query_metrics()
         else:
             out = state.list_placement_groups()
         json.dump(out, sys.stdout, indent=2, default=_jsonable)

@@ -129,3 +129,34 @@ def cluster_summary() -> Dict[str, Any]:
         "placement_groups": len(list_placement_groups()),
         "jobs": len(list_jobs()),
     }
+
+
+def memory_summary() -> Dict[str, Any]:
+    """Cluster object-memory view (reference: `ray memory` —
+    ref-count debugging + per-node store usage)."""
+    import asyncio
+
+    w = worker_mod.global_worker()
+
+    async def _collect():
+        nodes = await w.gcs_client.call("list_nodes")
+        stores = []
+        for n in nodes:
+            if not n["alive"]:
+                continue
+            try:
+                client = await w.nodelet_client_for_node(n["node_id"])
+                stats = await asyncio.wait_for(client.call("node_stats"), 10)
+                stores.append({
+                    "node_id": n["node_id"].hex(),
+                    "node_name": stats.get("node_name", ""),
+                    **(stats.get("store") or {}),
+                })
+            except Exception:
+                continue
+        return stores
+
+    return {
+        "stores": w.loop_thread.run(_collect()),
+        "this_process_refs": w.ref_counter.summary(),
+    }

@@ -94,3 +94,30 @@ def test_user_metrics_counter_gauge_histogram(ray_start_regular):
     assert merged["test_queue_depth"]["values"][()] == 7.0
     hist = merged["test_latency"]["values"][()]
     assert hist["count"] == 3 and hist["counts"] == [1, 1, 1]
+
+
+def test_memory_resource_schedules(ray_start_regular):
+    """`memory=` is a schedulable resource (reference: ray memory-aware
+    scheduling — admission control; OOM policy enforces)."""
+    import ray_tpu
+
+    total = ray_tpu.cluster_resources().get("memory", 0)
+    assert total > 0  # advertised from /proc/meminfo
+
+    @ray_tpu.remote
+    def uses_memory():
+        return 1
+
+    # Fits: schedules normally.
+    ref = uses_memory.options(memory=64 * 1024 * 1024).remote()
+    assert ray_tpu.get(ref, timeout=60) == 1
+
+
+def test_memory_summary_state(ray_start_regular):
+    from ray_tpu.util import state
+
+    ref = __import__("ray_tpu").put(b"x" * 2048)
+    mem = state.memory_summary()
+    assert mem["stores"] and "bytes_in_use" in mem["stores"][0]
+    assert mem["this_process_refs"]["owned"] >= 1
+    del ref
