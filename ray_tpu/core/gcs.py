@@ -376,11 +376,14 @@ class GcsServer:
     # ------------------------------------------------------------------
     async def rpc_kv_put(self, key: str, value: bytes,
                          overwrite: bool = True) -> bool:
-        if not overwrite and key in self.kv:
-            return False
+        """Returns True iff the key already existed (write is skipped when
+        overwrite=False), so first-writer-wins checks are a single RPC."""
+        existed = key in self.kv
+        if existed and not overwrite:
+            return True
         self.kv[key] = value
         self.mark_dirty()
-        return True
+        return existed
 
     async def rpc_kv_get(self, key: str) -> Optional[bytes]:
         return self.kv.get(key)

@@ -107,6 +107,10 @@ class Nodelet:
         self.session_dir = session_dir
         self.server = RpcServer(host, port)
         self.node_name = node_name or self.node_id.hex()[:8]
+        # Per-node worker-log namespace (session_dir may be shared across
+        # nodes on one filesystem).
+        self._worker_log_dir = os.path.join(
+            self.session_dir, "logs", self.node_id.hex()[:8])
 
         from ray_tpu._private.accelerators import detect_resources
 
@@ -195,34 +199,57 @@ class Nodelet:
     # worker log files → GCS pubsub → driver stdout)
     # ------------------------------------------------------------------
     async def _log_monitor_loop(self) -> None:
-        log_dir = os.path.join(self.session_dir, "logs")
+        # Tail only THIS node's worker logs. Multi-node clusters sharing one
+        # filesystem (cluster_utils, fake TPU-pod transport) would otherwise
+        # have N nodelets each republishing every worker's output with the
+        # wrong node label. Component logs (gcs.log, nodelet-*.log) live at
+        # the top level of the shared logs dir; exactly one nodelet claims
+        # them via an atomic first-writer-wins kv key.
+        log_dir = self._worker_log_dir
+        component_dir: Optional[str] = None
         offsets: Dict[str, int] = {}
         partial: Dict[str, bytes] = {}
         while not self._shutting_down:
             await asyncio.sleep(0.5)
             try:
-                names = sorted(os.listdir(log_dir)) if os.path.isdir(
-                    log_dir) else []
+                if component_dir is None and self._gcs is not None:
+                    existed = await self._gcs.call(
+                        "kv_put", key="logtail:component_leader",
+                        value=self.node_id.binary(), overwrite=False)
+                    leader = not existed or (await self._gcs.call(
+                        "kv_get", key="logtail:component_leader")
+                    ) == self.node_id.binary()
+                    component_dir = (os.path.join(self.session_dir, "logs")
+                                     if leader else "")
+                names = [
+                    (log_dir, n)
+                    for n in (sorted(os.listdir(log_dir))
+                              if os.path.isdir(log_dir) else [])]
+                if component_dir:
+                    names += [
+                        (component_dir, n)
+                        for n in sorted(os.listdir(component_dir))
+                        if os.path.isfile(os.path.join(component_dir, n))]
                 batches = []
-                for name in names:
+                for dirpath, name in names:
                     if not name.endswith(".log"):
                         continue
-                    path = os.path.join(log_dir, name)
+                    path = os.path.join(dirpath, name)
                     try:
                         size = os.path.getsize(path)
                     except OSError:
                         continue
-                    pos = offsets.get(name, 0)
+                    pos = offsets.get(path, 0)
                     if size <= pos:
                         continue
                     with open(path, "rb") as f:
                         f.seek(pos)
-                        chunk = partial.pop(name, b"") + f.read(
+                        chunk = partial.pop(path, b"") + f.read(
                             min(size - pos, 512 * 1024))
-                        offsets[name] = f.tell()
+                        offsets[path] = f.tell()
                     *lines, rest = chunk.split(b"\n")
                     if rest:
-                        partial[name] = rest
+                        partial[path] = rest
                     lines = [ln.decode("utf-8", "replace") for ln in lines
                              if ln.strip()]
                     # Ship everything read (offsets already advanced past
@@ -281,7 +308,7 @@ class Nodelet:
         if runtime_env:
             for k, v in (runtime_env.get("env_vars") or {}).items():
                 env[k] = v
-        log_dir = os.path.join(self.session_dir, "logs")
+        log_dir = self._worker_log_dir
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:8]}.log"), "wb")
         proc = subprocess.Popen(

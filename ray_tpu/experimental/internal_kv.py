@@ -23,11 +23,9 @@ def _internal_kv_put(key: bytes, value: bytes, overwrite: bool = True) -> bool:
     """Returns True if the key already existed (matching the reference's
     return convention)."""
     key_s = _NAMESPACE + (key.decode() if isinstance(key, bytes) else key)
-    existed = _call("kv_get", key=key_s) is not None
-    if existed and not overwrite:
-        return True
-    _call("kv_put", key=key_s, value=value, overwrite=True)
-    return existed
+    # Single atomic RPC: the GCS applies overwrite semantics server-side and
+    # reports whether the key already existed (no check-then-act race).
+    return bool(_call("kv_put", key=key_s, value=value, overwrite=overwrite))
 
 
 def _internal_kv_get(key: bytes) -> Optional[bytes]:

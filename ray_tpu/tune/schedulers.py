@@ -168,9 +168,13 @@ class HyperBandScheduler:
             # Finished keepers carry their FINAL value into the next rung
             # era as the standing bar (they trained at least as far as the
             # new milestone); live survivors re-record at the new milestone.
+            # The reporting trial that just hit max_t is still RUNNING here
+            # (the controller terminates it only after seeing our STOP), but
+            # it is finished for ranking purposes — carry it like TERMINATED.
             b["recorded"] = {
                 tid: b["last"].get(tid, v) for tid, v in ordered
-                if status.get(tid) in ("TERMINATED", "ERROR")
+                if (status.get(tid) in ("TERMINATED", "ERROR")
+                    or (tid == trial.trial_id and done))
                 and tid in keep_ids}
             if trial.trial_id in losers:
                 return STOP

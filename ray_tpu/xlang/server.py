@@ -20,6 +20,12 @@ the cross-language contract is "bytes in, bytes out" (apps bring their own
 serialization), mirroring how the reference crosses languages with
 msgpack-encoded buffers rather than shared object models.
 
+Pins and actor handles created for a client are tracked PER CONNECTION
+and released when the connection closes (explicit OP_RELEASE remains the
+fast path) — the same drop-on-disconnect contract the Python client proxy
+(util/client.py) implements, so a crashed C++ client can't leak objects
+for the server's lifetime.
+
 Reference counterparts: cpp/src/ray/ (C++ worker API), java runtime xlang
 calls; the C++ client for THIS protocol lives in cpp/ray_tpu_client.hpp.
 """
@@ -57,20 +63,28 @@ def register_actor_class(name: str, cls: Any) -> None:
     _actor_registry[name] = cls
 
 
+class _Session:
+    """Server-side state owned by one client connection."""
+
+    def __init__(self):
+        self.pins: Dict[str, Any] = {}    # ref id hex -> ObjectRef
+        self.actors: Dict[str, Any] = {}  # actor id hex -> handle
+
+
 class XlangServer:
     def __init__(self):
         self._server: Optional[asyncio.AbstractServer] = None
-        self._actors: Dict[str, Any] = {}  # actor id hex -> handle
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        session = _Session()
         try:
             while True:
                 head = await reader.readexactly(5)
                 (body_len,), op = struct.unpack(">I", head[:4]), head[4]
                 body = await reader.readexactly(body_len)
                 try:
-                    out = await self._dispatch(op, body)
+                    out = await self._dispatch(op, body, session)
                     status = 0
                 except Exception as e:  # noqa: BLE001
                     out = f"{type(e).__name__}: {e}".encode()
@@ -85,13 +99,32 @@ class XlangServer:
                 writer.close()
             except Exception:
                 pass
+            await self._reap_session(session)
+
+    async def _reap_session(self, session: _Session) -> None:
+        """Release everything a disconnected client left behind."""
+        import ray_tpu
+
+        session.pins.clear()
+        actors = list(session.actors.values())
+        session.actors.clear()
+        if not actors:
+            return
+        loop = asyncio.get_running_loop()
+        for handle in actors:
+            try:
+                await loop.run_in_executor(
+                    None, lambda h=handle: ray_tpu.kill(h))
+            except Exception:  # noqa: BLE001
+                pass  # reaping is best-effort; the actor may be dead already
 
     @staticmethod
     def _named(body: bytes) -> Tuple[str, bytes]:
         (nlen,) = struct.unpack(">H", body[:2])
         return body[2:2 + nlen].decode(), body[2 + nlen:]
 
-    async def _dispatch(self, op: int, body: bytes) -> bytes:
+    async def _dispatch(self, op: int, body: bytes,
+                        session: _Session) -> bytes:
         import ray_tpu
 
         loop = asyncio.get_running_loop()
@@ -101,12 +134,15 @@ class XlangServer:
             return await loop.run_in_executor(None, fn, payload)
         if op == OP_PUT:
             ref = await loop.run_in_executor(None, ray_tpu.put, bytes(body))
-            _pin(ref)
+            session.pins[ref.id.hex()] = ref
             return ref.id.hex().encode()
         if op == OP_GET:
             ref_hex = body.decode()
+            ref = session.pins.get(ref_hex)
+            if ref is None:
+                raise KeyError(f"unknown xlang ref {ref_hex}")
             value = await loop.run_in_executor(
-                None, lambda: _get_by_hex(ref_hex))
+                None, lambda: ray_tpu.get(ref, timeout=600))
             if not isinstance(value, (bytes, bytearray, memoryview)):
                 raise TypeError(
                     f"xlang GET of non-bytes value ({type(value).__name__})")
@@ -120,7 +156,7 @@ class XlangServer:
                 return rf.remote(payload)
 
             ref = await loop.run_in_executor(None, submit)
-            _pin(ref)
+            session.pins[ref.id.hex()] = ref
             return ref.id.hex().encode()
         if op == OP_ACTOR_NEW:
             name, payload = self._named(body)
@@ -131,7 +167,7 @@ class XlangServer:
 
             handle = await loop.run_in_executor(None, create)
             hexid = handle._actor_id.hex()
-            self._actors[hexid] = handle
+            session.actors[hexid] = handle
             return hexid.encode()
         if op == OP_ACTOR_CALL:
             (alen,) = struct.unpack(">H", body[:2])
@@ -140,7 +176,7 @@ class XlangServer:
             (mlen,) = struct.unpack(">H", rest[:2])
             method = rest[2:2 + mlen].decode()
             payload = rest[2 + mlen:]
-            handle = self._actors[actor_hex]
+            handle = session.actors[actor_hex]
 
             def call():
                 ref = getattr(handle, method).remote(payload)
@@ -151,13 +187,13 @@ class XlangServer:
                 raise TypeError("xlang actor method must return bytes")
             return bytes(out)
         if op == OP_RELEASE:
-            # Clients must release refs AND actors they are done with: the
-            # server pins both on the client's behalf (util/client.py has
-            # the same contract via client_release), and a leak here is
-            # unbounded store/actor growth.
+            # Clients should release refs AND actors they are done with as
+            # soon as possible (the disconnect reaper is the backstop, not
+            # the primary path — a long-lived client would otherwise grow
+            # the store unboundedly).
             hexid = body.decode()
-            _pins.pop(hexid, None)
-            handle = self._actors.pop(hexid, None)
+            session.pins.pop(hexid, None)
+            handle = session.actors.pop(hexid, None)
             if handle is not None:
                 await loop.run_in_executor(
                     None, lambda: ray_tpu.kill(handle))
@@ -169,24 +205,6 @@ class XlangServer:
         self._server = await asyncio.start_server(self._handle, host, port)
         addr = self._server.sockets[0].getsockname()
         return addr[0], addr[1]
-
-
-# Refs created on behalf of xlang clients are pinned here (the client holds
-# only a hex id; the Python-side session is the owner).
-_pins: Dict[str, Any] = {}
-
-
-def _pin(ref) -> None:
-    _pins[ref.id.hex()] = ref
-
-
-def _get_by_hex(ref_hex: str):
-    import ray_tpu
-
-    ref = _pins.get(ref_hex)
-    if ref is None:
-        raise KeyError(f"unknown xlang ref {ref_hex}")
-    return ray_tpu.get(ref, timeout=600)
 
 
 _server: Optional[XlangServer] = None
