@@ -145,6 +145,17 @@ def main():
             f"single_client_put_gigabytes: {put_gbps:.2f} GiB/s (ref 19.56)",
             file=sys.stderr,
         )
+        try:
+            from ray_tpu.benchmarks.dag_bench import run_dag_bench
+
+            dag = run_dag_bench(ray_tpu, n=200)
+            print(f"dag_channel_execute: {dag['dag_execute_per_s']}/s "
+                  f"({dag['dag_vs_ref_chain']}x vs hand-written ref chain, "
+                  f"{dag['dag_vs_stop_and_go']}x vs stop-and-go)",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"dag bench skipped: {type(e).__name__}: {e}",
+                  file=sys.stderr)
         print(json.dumps({
             "metric": "1_1_actor_calls_sync",
             "value": round(sync_rate, 1),

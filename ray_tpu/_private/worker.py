@@ -328,6 +328,7 @@ class ActorSubmitter:
         self.worker = worker
         self.actor_id = actor_id
         self.client: Optional[RpcClient] = None
+        self.control_client: Optional[RpcClient] = None
         self.address: Optional[Tuple[str, int]] = None
         self.queue: asyncio.Queue = asyncio.Queue()
         self._pump_task: Optional[asyncio.Task] = None
@@ -341,7 +342,19 @@ class ActorSubmitter:
     MAX_BATCH = 32
 
     async def _pump(self) -> None:
-        while self._held is not None or not self.queue.empty():
+        # Persistent: parks on queue.get() between calls instead of exiting,
+        # so steady-state submission wakes a waiter (~µs) rather than
+        # creating a fresh Task per call.
+        first = item = batch = fut = spec = deps = None
+        while True:
+            # Drop the previous iteration's locals BEFORE parking: a parked
+            # coroutine frame pins its locals, and a retained TaskSpec pins
+            # its arg ObjectRefs — the owner could never free them.
+            first = item = batch = fut = spec = deps = None
+            if self._held is not None:
+                first, self._held = self._held, None
+            else:
+                first = await self.queue.get()
             # Adaptive batching: drain whatever is queued (up to MAX_BATCH)
             # into one RPC frame — collapses per-call frame/syscall/task
             # overhead for pipelined submitters while a lone call still goes
@@ -349,14 +362,8 @@ class ActorSubmitter:
             # FIFO order (sync-actor ordering contract): a task whose owned
             # args are pending flushes the batch ahead of it, then waits.
             batch = []
-            while len(batch) < self.MAX_BATCH:
-                if self._held is not None:
-                    item, self._held = self._held, None
-                else:
-                    try:
-                        item = self.queue.get_nowait()
-                    except asyncio.QueueEmpty:
-                        break
+            item: Any = first
+            while True:
                 deps = self.worker.unresolved_owned_deps(item[0])
                 if deps:
                     if batch:
@@ -364,10 +371,44 @@ class ActorSubmitter:
                         break
                     await self.worker.wait_owned_deps(deps)
                 batch.append(item)
+                if len(batch) >= self.MAX_BATCH:
+                    break
+                try:
+                    item = self.queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
             if not batch:
                 continue
             try:
                 client = await self._ensure_client()
+                # Long-running pinned loops (compiled-DAG channels) must
+                # not occupy the fast lane's sequential connection — they
+                # reply only at teardown, which would head-of-line block
+                # every later call. Ship them via the control lane.
+                pinned = [it for it in batch
+                          if it[0].actor_method_name
+                          == "__dag_channel_loop__"]
+                if pinned:
+                    batch = [it for it in batch if it not in pinned]
+                    ctl = self.control_client or client
+                    for spec, retries, attempt in pinned:
+                        try:
+                            pfut = await ctl.start_call(
+                                "push_actor_task", spec=ser_spec(spec))
+                        except (ConnectionLost,
+                                asyncio.TimeoutError) as e:
+                            # Same contract as a failed batch send: retry
+                            # or fail the task — never drop it (a dropped
+                            # loop leaves the driver blocked on a channel
+                            # that no one will ever write).
+                            await self._on_send_failure(
+                                spec, retries, attempt, e)
+                            continue
+                        pfut.add_done_callback(
+                            lambda f, s=spec, r=retries, a=attempt:
+                            self._on_reply_done(s, r, a, f))
+                    if not batch:
+                        continue
                 if len(batch) == 1:
                     spec, retries, attempt = batch[0]
                     fut = await client.start_call("push_actor_task",
@@ -387,10 +428,26 @@ class ActorSubmitter:
                 continue
             if len(batch) == 1:
                 spec, retries, attempt = batch[0]
-                asyncio.ensure_future(
-                    self._handle_reply(spec, retries, attempt, fut))
+                fut.add_done_callback(
+                    lambda f, s=spec, r=retries, a=attempt:
+                    self._on_reply_done(s, r, a, f))
             else:
                 asyncio.ensure_future(self._handle_batch_reply(batch, fut))
+
+    def _on_reply_done(self, spec: TaskSpec, retries: int, attempt: int,
+                       fut: "asyncio.Future") -> None:
+        """Done-callback reply path: the overwhelmingly common reply (ok,
+        inline/shm results, no borrows) completes synchronously with no Task
+        creation; anything else falls back to the async handler."""
+        if fut.cancelled() or fut.exception() is not None:
+            asyncio.ensure_future(
+                self._handle_reply(spec, retries, attempt, fut))
+            return
+        reply = fut.result()
+        if self.worker.handle_task_reply_fast(spec, reply):
+            return
+        asyncio.ensure_future(
+            self._handle_reply(spec, retries, attempt, fut))
 
     async def _handle_batch_reply(self, batch, fut: "asyncio.Future") -> None:
         try:
@@ -453,6 +510,18 @@ class ActorSubmitter:
             if info["state"] == "ALIVE" and info["address"]:
                 self.address = tuple(info["address"])
                 self.client = RpcClient(*self.address, name="actor")
+                # Prefer the worker's fast lane (zero intra-worker hops;
+                # see Worker._start_fast_lane) when the actor runs one —
+                # same frame protocol, different port. The control client
+                # stays around for cancel/generator RPCs.
+                try:
+                    fl = await self.client.call("fast_lane_info", timeout=5)
+                    if fl and fl.get("port"):
+                        self.control_client = self.client
+                        self.client = RpcClient(
+                            self.address[0], fl["port"], name="actor-fl")
+                except Exception:
+                    pass  # older/busy worker: normal lane works fine
                 return self.client
             if info["state"] == "DEAD":
                 raise ActorDiedError(
@@ -466,8 +535,11 @@ class ActorSubmitter:
 
     def reset(self) -> None:
         client, self.client, self.address = self.client, None, None
-        if client is not None:
-            asyncio.ensure_future(client.close())
+        control = getattr(self, "control_client", None)
+        self.control_client = None
+        for c in (client, control):
+            if c is not None:
+                asyncio.ensure_future(c.close())
 
 
 def _prepare_runtime_env(runtime_env, gcs_call):
@@ -685,6 +757,8 @@ class Worker:
         s.register("cancel_task", self._rpc_cancel_task)
         s.register("exit_worker", self._rpc_exit_worker)
         s.register("ping", self._rpc_ping)
+        s.register("fast_lane_info", self._rpc_fast_lane_info)
+        s.register("dag_method_info", self._rpc_dag_method_info)
         s.register("device_object_fetch", self._rpc_device_object_fetch)
         s.register("device_object_free", self._rpc_device_object_free)
 
@@ -858,14 +932,42 @@ class Worker:
                     raise value
                 out.append(self._maybe_device(value))
             return out
+        if len(refs) == 1 and (refs[0].owner_address is None or
+                               tuple(refs[0].owner_address) == self.address):
+            # Owned single ref still pending: block this thread on the
+            # completion event directly — the reply callback (loop thread)
+            # sets it, one futex wake, no coroutine scheduling at all.
+            ref = refs[0]
+            entry = self.memory_store.get_blocking(ref.id, timeout)
+            if entry is None:
+                raise GetTimeoutError(f"timed out resolving {ref}")
+            if isinstance(entry, ser.SerializedObject):
+                value, is_error = ser.deserialize_or_error(entry)
+                if is_error:
+                    raise value
+                return [self._maybe_device(value)]
+            if (isinstance(entry, ShmMarker)
+                    and entry.node_id == self.node_id.binary()):
+                obj = self.shm.get_serialized(ref.id)
+                if obj is not None:
+                    value, is_error = ser.deserialize_or_error(obj)
+                    if is_error:
+                        raise value
+                    return [self._maybe_device(value)]
+            # Remote/spilled/device entries: the async machinery owns those.
         coro = self._get_async(refs, timeout)
         outer = None if timeout is None else timeout + 5
         return self.loop_thread.run(coro, timeout=outer)
 
     async def _get_async(self, refs: List[ObjectRef],
                          timeout: Optional[float]) -> List[Any]:
-        results = await asyncio.gather(
-            *[self._resolve_ref(r, timeout) for r in refs])
+        if len(refs) == 1:
+            # gather() wraps each coroutine in a Task; skip that for the
+            # ubiquitous single-ref get.
+            results = [await self._resolve_ref(refs[0], timeout)]
+        else:
+            results = await asyncio.gather(
+                *[self._resolve_ref(r, timeout) for r in refs])
         out = []
         for obj in results:
             value, is_error = ser.deserialize_or_error(obj)
@@ -1508,6 +1610,31 @@ class Worker:
         await self.handle_task_reply(spec, reply)
         return True
 
+    def handle_task_reply_fast(self, spec: TaskSpec,
+                               reply: Dict[str, Any]) -> bool:
+        """Synchronous reply handling for the common case (no borrows, no
+        device objects, not cancelled/generator, no retryable error).
+        Returns False to send the reply through the full async path."""
+        if (reply.get("borrows") or reply.get("device_objects")
+                or reply.get("cancelled") or "generator_count" in reply):
+            return False
+        results = []
+        for item in reply["results"]:
+            kind = item[0]
+            if kind == "inline":
+                results.append(ser.SerializedObject(item[1], item[2], []))
+            elif kind == "shm":
+                results.append(ShmMarker(item[1]))
+            elif kind == "error":
+                if spec.retry_exceptions:
+                    return False
+                results.append(
+                    ser.SerializedObject(ser.METADATA_ERROR, [item[1]], []))
+            else:
+                return False
+        self.task_manager.complete(spec.task_id, results)
+        return True
+
     async def handle_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
         # Synchronous borrow handoff (reference: task replies carry borrowed_refs
         # so the owner registers the executor as borrower BEFORE dropping the
@@ -1744,10 +1871,158 @@ class Worker:
                 asyncio.iscoroutinefunction(getattr(cls, m, None))
                 for m in dir(cls) if not m.startswith("__")
             )
+            if not self._actor_is_async and spec.max_concurrency <= 1:
+                self._start_fast_lane()
             return {"ok": True}
         except BaseException as e:
             logger.exception("actor creation failed")
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    # ------------------------------------------------------------------
+    # Actor fast lane.
+    #
+    # Motivation (measured on the 1-core bench host): a sync actor call
+    # through the asyncio server costs 6 thread/process wakeups — driver
+    # loop → worker loop → executor thread → worker loop → driver loop —
+    # and each wake is ~50-200µs of scheduler latency, putting the floor
+    # near 800µs/call. A single-threaded sync actor doesn't need any of
+    # that: one blocking thread can read→execute→reply with ZERO
+    # intra-worker hops. The asyncio plane stays authoritative for
+    # everything else (creation, cancel, generators via delegation,
+    # health checks). Reference contrast: core_worker's direct actor call
+    # path has the same shape (dedicated execution thread fed by the RPC
+    # plane) but its hop costs ~10µs in C++; ours is a redesign that
+    # removes the hop instead of cheapening it.
+    # ------------------------------------------------------------------
+    def _start_fast_lane(self) -> None:
+        import socket as _socket
+
+        lsock = _socket.socket()
+        lsock.setsockopt(_socket.SOL_SOCKET, _socket.SO_REUSEADDR, 1)
+        lsock.bind((self.server.host, 0))
+        lsock.listen(16)
+        self._fast_lane_port = lsock.getsockname()[1]
+        self._actor_exec_lock = threading.Lock()
+
+        def accept_loop() -> None:
+            while not self._shutdown:
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                conn.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+                t = threading.Thread(
+                    target=self._serve_fast_lane_conn, args=(conn,),
+                    name="fast-lane", daemon=True)
+                t.start()
+
+        threading.Thread(target=accept_loop, name="fast-lane-accept",
+                         daemon=True).start()
+
+    def _serve_fast_lane_conn(self, conn) -> None:
+        from ray_tpu._private.rpc import (
+            KIND_RESPONSE, recv_frame_blocking, send_frame_blocking)
+
+        try:
+            while not self._shutdown:
+                kind, msg_id, (method, kwargs) = recv_frame_blocking(conn)
+                try:
+                    if method == "push_actor_task":
+                        reply = self._fast_lane_execute(kwargs["spec"])
+                    elif method == "push_actor_task_batch":
+                        reply = {"replies": [
+                            self._fast_lane_execute(s)
+                            for s in kwargs["specs"]]}
+                    elif method == "ping":
+                        reply = {"ok": True}
+                    else:
+                        raise RuntimeError(
+                            f"method {method!r} not supported on fast lane")
+                    send_frame_blocking(conn, KIND_RESPONSE, msg_id,
+                                        (True, reply))
+                except BaseException as e:  # noqa: BLE001
+                    send_frame_blocking(conn, KIND_RESPONSE, msg_id,
+                                        (False, e))
+        except Exception:
+            pass  # disconnect: the submitter reconnects/retries
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _fast_lane_execute(self, spec_bytes: bytes) -> Dict[str, Any]:
+        spec = deser_spec(spec_bytes)
+        if spec.actor_method_name == "__dag_channel_loop__":
+            # Never on the fast lane: the loop replies only at teardown and
+            # this connection is strictly sequential (the submitter routes
+            # loops via the control lane; this is a guard).
+            return {"results": [self._error_result(RuntimeError(
+                "__dag_channel_loop__ must use the control lane"))]}
+        method = getattr(self._actor_instance, spec.actor_method_name, None)
+        if method is None:
+            return {"results": [self._error_result(AttributeError(
+                f"actor has no method {spec.actor_method_name!r}"))] *
+                max(1, spec.num_returns)}
+        # Mutual exclusion with the asyncio-plane executor thread: other
+        # handles (borrowers, other drivers) may still push through the
+        # normal lane concurrently.
+        with self._actor_exec_lock:
+            return self._execute_actor_task_sync(spec, method)
+
+    async def _rpc_fast_lane_info(self) -> Dict[str, Any]:
+        return {"port": getattr(self, "_fast_lane_port", None)}
+
+    async def _rpc_dag_method_info(self, method_name: str) -> Dict[str, Any]:
+        """Compile-time probe for CompiledDAG channel mode: the driver must
+        reject stages whose methods are async (a pinned sync loop would get
+        an un-awaited coroutine back)."""
+        m = getattr(self._actor_instance, method_name, None)
+        return {"exists": m is not None,
+                "is_async": bool(m is not None
+                                 and asyncio.iscoroutinefunction(m))}
+
+    def _dag_channel_loop(self, in_path: str, out_path: str,
+                          method_name: str) -> str:
+        """Pinned compiled-DAG stage loop (reference: aDAG's per-actor
+        execution loops, dag/compiled_dag_node.py): read the input shm
+        channel, run the method, write the output channel — zero RPCs per
+        item. Exits when the input channel closes (dag.teardown). Runs on
+        an executor thread; the per-item exec lock keeps max_concurrency=1
+        semantics against fast-lane calls."""
+        from ray_tpu.dag import _DagChannelError
+        from ray_tpu.experimental.channel import ShmChannel
+        from ray_tpu.experimental.channel.shm_channel import ChannelClosed
+
+        cin = ShmChannel(in_path)
+        cout = ShmChannel(out_path)
+        lock = getattr(self, "_actor_exec_lock", None)
+        method = getattr(self._actor_instance, method_name)
+        try:
+            while True:
+                try:
+                    value = cin.read()
+                except ChannelClosed:
+                    return "closed"
+                try:
+                    if isinstance(value, _DagChannelError):
+                        out: Any = value  # upstream failed: propagate
+                    elif lock is not None:
+                        with lock:
+                            out = method(value)
+                    else:
+                        out = method(value)
+                except BaseException as e:  # noqa: BLE001
+                    out = _DagChannelError(e)
+                try:
+                    cout.write(out)
+                except Exception as e:  # noqa: BLE001
+                    # Unserializable / slot-overflow result: surface the
+                    # real cause downstream instead of dying with an
+                    # opaque ChannelClosed.
+                    cout.write(_DagChannelError(e))
+        finally:
+            cout.close()
 
     async def _rpc_push_actor_task_batch(self, specs: List[bytes]) -> Dict[str, Any]:
         """Execute a batch of actor tasks. Runs of consecutive sync methods
@@ -1790,7 +2065,8 @@ class Worker:
                 j += 1
 
             def run_sync(items=run):
-                return [self._execute_actor_task_sync(s, m) for s, m in items]
+                return [self._execute_actor_task_locked(s, m)
+                        for s, m in items]
 
             futs.append(loop.run_in_executor(self._actor_executors[""],
                                              run_sync))
@@ -1806,6 +2082,14 @@ class Worker:
         return {"replies": replies}
 
     async def _rpc_push_actor_task(self, spec: bytes) -> Dict[str, Any]:
+        if os.environ.get("RAY_TPU_PUSH_TRACE"):
+            t0 = time.perf_counter_ns()
+            task_spec = deser_spec(spec)
+            t1 = time.perf_counter_ns()
+            reply = await self._rpc_push_actor_task_decoded(task_spec)
+            t2 = time.perf_counter_ns()
+            reply["_trace"] = {"entry": t0, "decoded": t1, "done": t2}
+            return reply
         return await self._rpc_push_actor_task_decoded(deser_spec(spec))
 
     async def _rpc_push_actor_task_decoded(
@@ -1814,6 +2098,19 @@ class Worker:
             return {"results": [self._error_result(
                 ActorDiedError("actor instance not initialized"))] *
                 max(1, task_spec.num_returns)}
+        if task_spec.actor_method_name == "__dag_channel_loop__":
+            # Dedicated thread: the loop runs until dag.teardown, and
+            # parking it on the shared '' executor (max_workers=1 for
+            # mc=1 actors) would starve every other normal-lane execution.
+            loop = asyncio.get_running_loop()
+            ex = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="dag-loop")
+            try:
+                return await loop.run_in_executor(
+                    ex, self._execute_actor_task_sync,
+                    task_spec, self._dag_channel_loop)
+            finally:
+                ex.shutdown(wait=False)
         method = getattr(self._actor_instance, task_spec.actor_method_name, None)
         if method is None:
             return {"results": [self._error_result(AttributeError(
@@ -1833,19 +2130,43 @@ class Worker:
         loop = asyncio.get_running_loop()
         executor = self._actor_executors.get(
             task_spec.concurrency_group) or self._actor_executors[""]
+        if os.environ.get("RAY_TPU_PUSH_TRACE"):
+            tpre = time.perf_counter_ns()
+            reply = await loop.run_in_executor(
+                executor, self._execute_actor_task_locked, task_spec, method)
+            reply["_trace_hop"] = {
+                "pre_hop": tpre, "post_hop": time.perf_counter_ns()}
+            return reply
         return await loop.run_in_executor(
-            executor, self._execute_actor_task_sync, task_spec, method)
+            executor, self._execute_actor_task_locked, task_spec, method)
+
+    def _execute_actor_task_locked(self, spec: TaskSpec,
+                                   method: Any) -> Dict[str, Any]:
+        """Normal-lane execution, serialized against the fast lane when one
+        is active (both lanes may receive tasks for the same
+        max_concurrency=1 actor from different handles)."""
+        lock = getattr(self, "_actor_exec_lock", None)
+        if lock is None:
+            return self._execute_actor_task_sync(spec, method)
+        with lock:
+            return self._execute_actor_task_sync(spec, method)
 
     def _execute_actor_task_sync(self, spec: TaskSpec, method: Any) -> Dict[str, Any]:
         t0 = time.time()
         ok = True
         try:
+            texec = (time.perf_counter_ns()
+                     if os.environ.get("RAY_TPU_PUSH_TRACE") else 0)
             args, kwargs = self._resolve_spec_args_sync(spec)
             self._current_task_id = spec.task_id
             result = method(*args, **kwargs)
             if spec.num_returns == -1:
                 return self._stream_generator(spec, iter(result))
-            return self._reply_results(spec, result)
+            reply = self._reply_results(spec, result)
+            if texec:
+                reply["_trace_exec"] = {
+                    "exec_start": texec, "exec_end": time.perf_counter_ns()}
+            return reply
         except BaseException as e:  # noqa: BLE001
             ok = False
             return {"results": [self._error_result(e)] * max(1, spec.num_returns)}

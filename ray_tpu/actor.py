@@ -70,6 +70,10 @@ class ActorHandle:
         object.__setattr__(self, "_max_task_retries", max_task_retries)
 
     def __getattr__(self, name: str) -> ActorMethod:
+        if name == "__dag_channel_loop__":
+            # Runtime-provided pinned loop for compiled-DAG channels
+            # (worker.Worker._dag_channel_loop), not a user method.
+            return ActorMethod(self, name)
         if name.startswith("_"):
             raise AttributeError(name)
         if self._method_names and name not in self._method_names:

@@ -166,6 +166,10 @@ def remote(*args, **options) -> Union[RemoteFunction, ActorClass]:
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None) -> Any:
+    # Channel-mode compiled-DAG outputs carry their own blocking read
+    # (reference: CompiledDAGRef supports ray.get).
+    if hasattr(refs, "get") and type(refs).__name__ == "CompiledDAGRef":
+        return refs.get(timeout)
     if _global_client is not None:
         return _global_client.get(refs, timeout=timeout)
     w = worker_mod.global_worker()

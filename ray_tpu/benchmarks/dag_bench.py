@@ -1,0 +1,72 @@
+"""Compiled-DAG fast-path benchmark (VERDICT r2 #10: prove or fix).
+
+Compares, over a 3-stage actor chain:
+  a) raw chained sync calls      — submit stage1, get, submit stage2, ...
+  b) raw chained ref-passing     — submit all three with upstream refs as
+                                   args, one final get (pipeliend submit)
+  c) compiled.execute()          — ray_tpu.dag replay
+
+Reference built aDAG because its per-call overhead was measurable
+(python/ray/dag/compiled_dag_node.py); here submission is already a direct
+actor push, so the question is whether the dag layer adds or removes
+overhead relative to hand-written chaining.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+
+def run_dag_bench(ray_tpu, n: int = 300, payload_bytes: int = 1024
+                  ) -> Dict[str, Any]:
+    import numpy as np
+
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote
+    class Stage:
+        def work(self, x):
+            return x
+
+    s1, s2, s3 = Stage.remote(), Stage.remote(), Stage.remote()
+    payload = np.ones(payload_bytes, np.uint8)
+    # warm-up (worker spawn + connections)
+    ray_tpu.get(s3.work.remote(ray_tpu.get(s2.work.remote(
+        ray_tpu.get(s1.work.remote(payload))))))
+
+    # a) stop-and-go chaining
+    t0 = time.perf_counter()
+    for _ in range(n):
+        a = ray_tpu.get(s1.work.remote(payload))
+        b = ray_tpu.get(s2.work.remote(a))
+        ray_tpu.get(s3.work.remote(b))
+    stop_and_go = n / (time.perf_counter() - t0)
+
+    # b) ref-passing chaining (what a user writes by hand)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r1 = s1.work.remote(payload)
+        r2 = s2.work.remote(r1)
+        ray_tpu.get(s3.work.remote(r2))
+    ref_chain = n / (time.perf_counter() - t0)
+
+    # c) compiled dag replay
+    with InputNode() as inp:
+        node = s3.work.bind(s2.work.bind(s1.work.bind(inp)))
+    compiled = node.experimental_compile()
+    compiled.execute(payload)  # warm the compiled path
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(compiled.execute(payload))
+    dag_rate = n / (time.perf_counter() - t0)
+    compiled.teardown()
+    for s in (s1, s2, s3):
+        ray_tpu.kill(s)
+    return {
+        "chain_stop_and_go_per_s": round(stop_and_go, 1),
+        "chain_ref_passing_per_s": round(ref_chain, 1),
+        "dag_execute_per_s": round(dag_rate, 1),
+        "dag_vs_ref_chain": round(dag_rate / ref_chain, 3),
+        "dag_vs_stop_and_go": round(dag_rate / stop_and_go, 3),
+    }

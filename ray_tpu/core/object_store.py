@@ -294,14 +294,50 @@ class MemoryStore:
         self._loop = loop
         self._objects: Dict[ObjectID, SerializedObject] = {}
         self._events: Dict[ObjectID, "MemoryStore._Waiter"] = {}
+        self._thread_events: Dict[ObjectID, list] = {}
         self._lock = threading.Lock()
 
     def put(self, object_id: ObjectID, obj: SerializedObject) -> None:
         with self._lock:
             self._objects[object_id] = obj
             waiter = self._events.pop(object_id, None)
+            tevents = self._thread_events.pop(object_id, None)
         if waiter is not None:
             self._loop.call_soon_threadsafe(waiter.event.set)
+        if tevents:
+            for ev in tevents:
+                ev.set()
+
+    def get_blocking(self, object_id: ObjectID,
+                     timeout: Optional[float] = None
+                     ) -> Optional[SerializedObject]:
+        """Block the CALLING thread until the object arrives — no event-loop
+        round trip. Used by the sync `ray.get` fast path: the completing
+        reply callback sets a plain threading.Event, so the driver's main
+        thread wakes directly (one futex) instead of via
+        run_coroutine_threadsafe + Task + concurrent.Future (three wakes).
+        Returns None on timeout."""
+        ev = threading.Event()
+        with self._lock:
+            obj = self._objects.get(object_id)
+            if obj is not None:
+                return obj
+            self._thread_events.setdefault(object_id, []).append(ev)
+        try:
+            if not ev.wait(timeout):
+                return None
+        finally:
+            with self._lock:
+                lst = self._thread_events.get(object_id)
+                if lst is not None:
+                    try:
+                        lst.remove(ev)
+                    except ValueError:
+                        pass
+                    if not lst:
+                        del self._thread_events[object_id]
+        with self._lock:
+            return self._objects.get(object_id)
 
     def get_if_exists(self, object_id: ObjectID) -> Optional[SerializedObject]:
         with self._lock:

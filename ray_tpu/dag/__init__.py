@@ -88,10 +88,62 @@ class MultiOutputNode(DAGNode):
         self.outputs = list(outputs)
 
 
-class CompiledDAG:
-    """Pre-resolved executable graph (reference: compiled_dag_node.py:808)."""
+class CompiledDAGRef:
+    """Handle to one channel-mode execution's output (reference:
+    CompiledDAGRef, dag/compiled_dag_node.py). `ray_tpu.get` accepts it."""
 
-    def __init__(self, output_node: DAGNode):
+    __slots__ = ("_dag", "_value", "_done")
+
+    def __init__(self, dag: "CompiledDAG"):
+        self._dag = dag
+        self._value = None
+        self._done = False
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._done:
+            self._value = self._dag._read_output(timeout)
+            self._done = True
+        if isinstance(self._value, _DagChannelError):
+            raise self._value.rebuild()
+        return self._value
+
+
+class _DagChannelError:
+    """Exception crossing a shm channel (pickled cause + repr fallback)."""
+
+    def __init__(self, exc: BaseException):
+        import pickle
+
+        self.repr = repr(exc)
+        try:
+            self.pickled = pickle.dumps(exc)
+        except Exception:
+            self.pickled = None
+
+    def rebuild(self) -> BaseException:
+        import pickle
+
+        if self.pickled is not None:
+            try:
+                return pickle.loads(self.pickled)
+            except Exception:
+                pass
+        return RuntimeError(f"DAG stage raised: {self.repr}")
+
+
+class CompiledDAG:
+    """Pre-resolved executable graph (reference: compiled_dag_node.py:808).
+
+    Two execution modes:
+    - channel mode (linear same-host chains): per-edge mutable shm ring
+      channels + a pinned loop task per actor — zero RPCs per execute()
+      (reference: shared_memory_channel.py:151 + aDAG's pinned loops);
+    - actor-push mode (everything else): replay through the ordered actor
+      submitter queues.
+    """
+
+    def __init__(self, output_node: DAGNode, *,
+                 enable_channels: bool = True):
         self._output = output_node
         self._order: List[ClassMethodNode] = []
         self._input_nodes: List[InputNode] = []
@@ -100,6 +152,16 @@ class CompiledDAG:
         if not self._input_nodes:
             raise ValueError("DAG has no InputNode")
         self._executions = 0
+        self._channels: List[Any] = []
+        self._loop_refs: List[Any] = []
+        self._pending_ref: Optional[CompiledDAGRef] = None
+        self._channel_mode = False
+        if enable_channels and self._is_linear_local_chain():
+            try:
+                self._setup_channels()
+                self._channel_mode = True
+            except Exception:
+                self._teardown_channels()
 
     def _walk(self, node: DAGNode) -> None:
         if id(node) in self._visited:
@@ -113,6 +175,106 @@ class CompiledDAG:
         elif isinstance(node, ClassMethodNode):
             self._order.append(node)  # post-order == topological
 
+    # ------------------------------------------------------------------
+    # Channel fast path
+    # ------------------------------------------------------------------
+    def _is_linear_local_chain(self) -> bool:
+        """Channel mode preconditions: single input, each stage consumes
+        exactly the previous stage (or the input) as its only arg, distinct
+        actors, no device transport, plain (non-Multi) output."""
+        if isinstance(self._output, MultiOutputNode):
+            return False
+        if len(self._input_nodes) != 1 or not self._order:
+            return False
+        prev: DAGNode = self._input_nodes[0]
+        seen_actors = set()
+        for node in self._order:
+            if node._tensor_transport:
+                return False
+            if len(node.args) != 1 or node.kwargs:
+                return False
+            if node.args[0] is not prev:
+                return False
+            aid = node.actor_handle._actor_id
+            if aid in seen_actors:
+                return False
+            seen_actors.add(aid)
+            prev = node
+        return prev is self._output
+
+    def _setup_channels(self) -> None:
+        import os
+        import uuid
+
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.experimental.channel import ShmChannel
+
+        w = worker_mod.global_worker()
+        # Same-filesystem requirement: every actor must live on this host
+        # (cluster_utils multi-"node" on one machine still qualifies).
+        my_host = w.address[0]
+        for node in self._order:
+            info = w.loop_thread.run(
+                w.actor_state(node.actor_handle._actor_id, refresh=True))
+            if (not info or info.get("state") != "ALIVE"
+                    or not info.get("address")
+                    or info["address"][0] != my_host):
+                raise RuntimeError("actor not local; channel mode off")
+            # The pinned loop is synchronous — an async method would come
+            # back as an un-awaited coroutine. Probe the live instance.
+            minfo = self._probe_method(w, tuple(info["address"]),
+                                       node.method_name)
+            if not minfo.get("exists") or minfo.get("is_async"):
+                raise RuntimeError(
+                    f"method {node.method_name!r} missing or async; "
+                    "channel mode off")
+        base = os.path.join("/dev/shm",
+                            f"ray_tpu_dag_{uuid.uuid4().hex[:12]}")
+        n = len(self._order)
+        self._channels = [
+            ShmChannel(f"{base}_{i}", create=True) for i in range(n + 1)]
+        self._loop_refs = []
+        for i, node in enumerate(self._order):
+            method = getattr(node.actor_handle, "__dag_channel_loop__")
+            self._loop_refs.append(method.remote(
+                in_path=self._channels[i].path,
+                out_path=self._channels[i + 1].path,
+                method_name=node.method_name))
+
+    @staticmethod
+    def _probe_method(w, address: Tuple[str, int],
+                      method_name: str) -> Dict[str, Any]:
+        from ray_tpu._private.rpc import RpcClient
+
+        async def probe():
+            client = RpcClient(*address, name="dag-probe")
+            try:
+                return await client.call("dag_method_info",
+                                         method_name=method_name,
+                                         timeout=10)
+            finally:
+                await client.close()
+
+        return w.loop_thread.run(probe())
+
+    def _read_output(self, timeout: Optional[float] = None):
+        return self._channels[-1].read(timeout)
+
+    def _teardown_channels(self) -> None:
+        for ch in self._channels:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        for ch in self._channels:
+            try:
+                ch.destroy()
+            except Exception:
+                pass
+        self._channels = []
+        self._loop_refs = []
+        self._channel_mode = False
+
     def execute(self, *input_args, **input_kwargs):
         """Submit one wave through the graph; returns the output ref (or a
         tuple of refs for MultiOutputNode). Multiple executes pipeline —
@@ -124,6 +286,20 @@ class CompiledDAG:
         else:
             input_val = input_args
         self._executions += 1
+        if self._channel_mode:
+            # Single in-flight execution per compiled dag (single-slot
+            # channels): drain the previous output before overwriting the
+            # input slot. A previous execution's ERROR belongs to its own
+            # ref (already cached there) — it must not poison this one.
+            if self._pending_ref is not None:
+                prev, self._pending_ref = self._pending_ref, None
+                try:
+                    prev.get()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._channels[0].write(input_val)
+            self._pending_ref = CompiledDAGRef(self)
+            return self._pending_ref
         results: Dict[int, Any] = {}
 
         def resolve(a):
@@ -148,9 +324,12 @@ class CompiledDAG:
         return results[id(out)]
 
     def teardown(self) -> None:
+        if self._channel_mode:
+            self._pending_ref = None
+            self._teardown_channels()
         self._order.clear()
         self._visited.clear()
 
 
-__all__ = ["CompiledDAG", "ClassMethodNode", "DAGNode", "InputNode",
-           "MultiOutputNode"]
+__all__ = ["CompiledDAG", "CompiledDAGRef", "ClassMethodNode", "DAGNode",
+           "InputNode", "MultiOutputNode"]
