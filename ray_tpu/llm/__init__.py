@@ -19,7 +19,13 @@ from ray_tpu.llm._internal.paged import (
     paged_gather,
     paged_write,
 )
+from ray_tpu.llm._internal.openai import OpenAIServer, build_openai_app
 from ray_tpu.llm._internal.server import LLMServer
+from ray_tpu.llm._internal.tokenizer import (
+    ByteBPETokenizer,
+    apply_chat_template,
+    get_tokenizer,
+)
 
 
 def build_llm_deployment(llm_config: Dict[str, Any], *,
@@ -41,9 +47,14 @@ def build_llm_deployment(llm_config: Dict[str, Any], *,
 
 
 __all__ = [
+    "ByteBPETokenizer",
     "EngineConfig",
     "LLMEngine",
     "LLMServer",
+    "OpenAIServer",
+    "apply_chat_template",
+    "build_openai_app",
+    "get_tokenizer",
     "PagedCacheConfig",
     "Processor",
     "ProcessorConfig",

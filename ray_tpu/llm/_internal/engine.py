@@ -77,20 +77,52 @@ class StepOutput:
 
 
 class LLMEngine:
-    """add_request() + step() — the scheduler half of continuous batching."""
+    """add_request() + step() — the scheduler half of continuous batching.
 
-    def __init__(self, model, params, cfg: EngineConfig):
+    Tensor parallel: pass `mesh` (any jax.sharding.Mesh with a "tensor"
+    axis). Params shard per LLAMA_SHARDING (heads/mlp/vocab over tensor),
+    the paged KV cache shards over its kv-head axis, and the jitted
+    prefill/decode steps run SPMD — XLA inserts the all-reduces over ICI
+    (reference passes tensor_parallel_size into vLLM,
+    serve/deployments/llm/vllm/vllm_models.py:125; here TP is native).
+    """
+
+    def __init__(self, model, params, cfg: EngineConfig, mesh=None):
         self.model = model
-        self.params = params
         self.cfg = cfg
+        self.mesh = mesh
         mcfg = model.cfg
         self.cache_cfg = PagedCacheConfig(
             num_pages=cfg.resolved_num_pages() + 1,  # +1: OOB drop page
             page_size=cfg.page_size, max_seqs=cfg.max_seqs,
             max_pages_per_seq=cfg.max_pages_per_seq)
-        self.caches = init_paged_cache(
+        caches = init_paged_cache(
             self.cache_cfg, mcfg.num_layers, mcfg.num_kv_heads,
             mcfg.head_dim, mcfg.dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ray_tpu.models.llama import LLAMA_SHARDING
+            from ray_tpu.parallel.sharding import shard_tree, spec_for
+
+            params = shard_tree(
+                params, LLAMA_SHARDING.tree_shardings(mesh, params))
+            kv_spec = spec_for(("kv_heads", None, None, None), mesh=mesh)
+            # Respect indivisible kv-head counts (tiny test models).
+            tp = 1
+            for ax in (kv_spec[0],) if kv_spec else ():
+                if ax is not None:
+                    for a in (ax,) if isinstance(ax, str) else ax:
+                        tp *= dict(zip(mesh.axis_names, mesh.devices.shape)
+                                   ).get(a, 1)
+            if tp > 1 and mcfg.num_kv_heads % tp:
+                kv_spec = PartitionSpec()
+            kv_sharding = NamedSharding(mesh, kv_spec)
+            self._replicated = NamedSharding(mesh, PartitionSpec())
+            caches = jax.tree.map(
+                lambda x: jax.device_put(x, kv_sharding), caches)
+        self.params = params
+        self.caches = caches
         self.allocator = PageAllocator(self.cache_cfg)
         # reserve nothing: allocator hands out real pages; the scatter's
         # drop-page is index num_pages (out of bounds by construction).
@@ -175,6 +207,14 @@ class LLMEngine:
         self._prefill_fns[bucket] = fn
         return fn
 
+    def _dev(self, x):
+        """Host → device, replicated across the mesh when TP is on (scalar
+        control state rides along every shard)."""
+        arr = jnp.asarray(x)
+        if self.mesh is not None:
+            return jax.device_put(arr, self._replicated)
+        return arr
+
     # ------------------------------------------------------------------
     # Scheduler
     # ------------------------------------------------------------------
@@ -206,9 +246,9 @@ class LLMEngine:
         for slot in self.running:
             active[slot] = True
         toks, self.caches, self._rng = self._decode_fn(
-            self.params, self.caches, jnp.asarray(self.last_tokens),
-            jnp.asarray(self.page_table), jnp.asarray(self.seq_lens),
-            jnp.asarray(active), jnp.asarray(self.temps), self._rng)
+            self.params, self.caches, self._dev(self.last_tokens),
+            self._dev(self.page_table), self._dev(self.seq_lens),
+            self._dev(active), self._dev(self.temps), self._rng)
         toks = np.asarray(toks)  # [K, B]
         for slot, req in list(self.running.items()):
             for j in range(K):
@@ -248,9 +288,9 @@ class LLMEngine:
             ids[0, :T] = req.prompt_ids
             self.temps[slot] = req.temperature
             tok, self.caches, self._rng = self._prefill_fn(bucket)(
-                self.params, self.caches, jnp.asarray(ids),
-                jnp.asarray(row), jnp.asarray(T, jnp.int32),
-                jnp.asarray(req.temperature, jnp.float32), self._rng)
+                self.params, self.caches, self._dev(ids),
+                self._dev(row), self._dev(np.int32(T)),
+                self._dev(np.float32(req.temperature)), self._rng)
             tok = int(tok)
             self.seq_lens[slot] = T
             self.last_tokens[slot] = tok

@@ -1,0 +1,238 @@
+"""OpenAI-compatible serving surface over the TPU LLM engine.
+
+Reference: python/ray/llm/_internal/serve/builders/application_builders.py
+(build_openai_app) + deployments/llm/llm_server.py (chat/completions
+handlers). There the HTTP surface is FastAPI on vLLM; here it is a plain
+serve deployment behind the stdlib proxy (serve/_proxy.py) speaking the
+OpenAI JSON/SSE wire shapes:
+
+  GET  /v1/models
+  POST /v1/completions        {"prompt": ..., "stream": bool, ...}
+  POST /v1/chat/completions   {"messages": [...], "stream": bool, ...}
+
+Text in, text out: prompts are tokenized with the bundled byte-level BPE
+(tokenizer.py — the zero-egress replacement for HF tokenizers) and decoded
+incrementally for streaming (UTF-8 partials held back until complete).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Dict, Iterator, List, Optional
+
+from ray_tpu.llm._internal.server import LLMServer
+from ray_tpu.llm._internal.tokenizer import (
+    ByteBPETokenizer,
+    apply_chat_template,
+    get_tokenizer,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _sse(obj: Dict[str, Any]) -> str:
+    return f"data: {json.dumps(obj)}\n\n"
+
+
+class _IncrementalDecoder:
+    """Streams text from token ids, holding back incomplete UTF-8 tails so
+    chunk boundaries never split multi-byte characters."""
+
+    def __init__(self, tok: ByteBPETokenizer):
+        self._tok = tok
+        self._ids: List[int] = []
+        self._emitted = 0
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        text = self._tok.decode(self._ids)
+        if text.endswith("�"):
+            return ""  # partial multi-byte char: wait for more tokens
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta
+
+
+class OpenAIServer:
+    """Serve deployment: OpenAI-compatible endpoints over one engine."""
+
+    def __init__(self, llm_config: Dict[str, Any]):
+        self.model_id = llm_config.get("model_id") or llm_config.get(
+            "model", "model")
+        self.tokenizer = get_tokenizer(llm_config)
+        self.server = LLMServer(llm_config)
+        self.created = int(time.time())
+
+    # -- entry point (proxy calls __call__ with the request dict) --------
+    def __call__(self, request: Dict[str, Any]):
+        suffix = request.get("suffix", "/")
+        body = request.get("body") or {}
+        stream = isinstance(body, dict) and body.get("stream") is True
+        try:
+            if suffix.rstrip("/").endswith("/models"):
+                return self._models()
+            if suffix.rstrip("/").endswith("/chat/completions"):
+                if stream:
+                    return self._chat_stream(body)
+                return self._chat(body)
+            if suffix.rstrip("/").endswith("/completions"):
+                if stream:
+                    return self._completions_stream(body)
+                return self._completions(body)
+        except ValueError as e:
+            return _error(400, str(e))
+        return _error(404, f"no OpenAI route for {suffix!r}")
+
+    # -- /v1/models ------------------------------------------------------
+    def _models(self) -> Dict[str, Any]:
+        return {"object": "list", "data": [{
+            "id": self.model_id, "object": "model",
+            "created": self.created, "owned_by": "ray_tpu"}]}
+
+    # -- prompt handling -------------------------------------------------
+    def _prompt_ids(self, body: Dict[str, Any]) -> List[int]:
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            if prompt and isinstance(prompt[0], int):
+                return [int(t) for t in prompt]  # pre-tokenized
+            prompt = "".join(str(p) for p in prompt)
+        return self.tokenizer.encode(str(prompt), add_bos=True)
+
+    def _chat_ids(self, body: Dict[str, Any]) -> List[int]:
+        messages = body.get("messages")
+        if not isinstance(messages, list) or not messages:
+            raise ValueError("chat/completions requires 'messages'")
+        return apply_chat_template(self.tokenizer, messages)
+
+    def _gen_kwargs(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        return {
+            "max_tokens": int(body.get("max_tokens") or 64),
+            "temperature": float(body.get("temperature") or 0.0),
+            "stop_token": self.tokenizer.eot_id,
+        }
+
+    # -- unary -----------------------------------------------------------
+    def _completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        ids = self._prompt_ids(body)
+        out = self.server.generate_all(ids, **self._gen_kwargs(body))
+        text = self.tokenizer.decode(out["tokens"])
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.model_id,
+            "choices": [{"index": 0, "text": text,
+                         "finish_reason": _finish(out["tokens"], body,
+                                                  self.tokenizer)}],
+            "usage": _usage(ids, out["tokens"]),
+        }
+
+    def _chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        ids = self._chat_ids(body)
+        out = self.server.generate_all(ids, **self._gen_kwargs(body))
+        text = self.tokenizer.decode(out["tokens"])
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.model_id,
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant", "content": text},
+                         "finish_reason": _finish(out["tokens"], body,
+                                                  self.tokenizer)}],
+            "usage": _usage(ids, out["tokens"]),
+        }
+
+    # -- streaming (SSE) -------------------------------------------------
+    def _completions_stream(self, body: Dict[str, Any]) -> Iterator[Any]:
+        ids = self._prompt_ids(body)
+        rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        yield {"__http__": {"content_type": "text/event-stream"}}
+        dec = _IncrementalDecoder(self.tokenizer)
+        for item in self.server.generate(ids, **self._gen_kwargs(body)):
+            delta = dec.push(item["token"])
+            if delta:
+                yield _sse({
+                    "id": rid, "object": "text_completion",
+                    "created": int(time.time()), "model": self.model_id,
+                    "choices": [{"index": 0, "text": delta,
+                                 "finish_reason": None}]})
+        yield _sse({
+            "id": rid, "object": "text_completion",
+            "created": int(time.time()), "model": self.model_id,
+            "choices": [{"index": 0, "text": "", "finish_reason": "stop"}]})
+        yield "data: [DONE]\n\n"
+
+    def _chat_stream(self, body: Dict[str, Any]) -> Iterator[Any]:
+        ids = self._chat_ids(body)
+        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        yield {"__http__": {"content_type": "text/event-stream"}}
+        yield _sse({
+            "id": rid, "object": "chat.completion.chunk",
+            "created": int(time.time()), "model": self.model_id,
+            "choices": [{"index": 0,
+                         "delta": {"role": "assistant", "content": ""},
+                         "finish_reason": None}]})
+        dec = _IncrementalDecoder(self.tokenizer)
+        for item in self.server.generate(ids, **self._gen_kwargs(body)):
+            delta = dec.push(item["token"])
+            if delta:
+                yield _sse({
+                    "id": rid, "object": "chat.completion.chunk",
+                    "created": int(time.time()), "model": self.model_id,
+                    "choices": [{"index": 0, "delta": {"content": delta},
+                                 "finish_reason": None}]})
+        yield _sse({
+            "id": rid, "object": "chat.completion.chunk",
+            "created": int(time.time()), "model": self.model_id,
+            "choices": [{"index": 0, "delta": {},
+                         "finish_reason": "stop"}]})
+        yield "data: [DONE]\n\n"
+
+    # -- misc ------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return self.server.stats()
+
+    def check_health(self) -> bool:
+        return self.server.check_health()
+
+
+def _finish(tokens: List[int], body: Dict[str, Any],
+            tok: ByteBPETokenizer) -> str:
+    if tokens and tokens[-1] == tok.eot_id:
+        return "stop"
+    return "length"
+
+
+def _usage(prompt_ids: List[int], out_tokens: List[int]) -> Dict[str, int]:
+    return {"prompt_tokens": len(prompt_ids),
+            "completion_tokens": len(out_tokens),
+            "total_tokens": len(prompt_ids) + len(out_tokens)}
+
+
+def _error(status: int, message: str) -> Dict[str, Any]:
+    return {"__http__": {"status": status},
+            "body": {"error": {"message": message, "type": "invalid_request_error"}}}
+
+
+def build_openai_app(llm_config: Dict[str, Any], *,
+                     num_replicas: int = 1,
+                     name: Optional[str] = None,
+                     num_tpus: float = 0.0):
+    """serve Application: OpenAI-compatible endpoints for one model.
+    Deploy with serve.run(app, route_prefix="/v1") and point any OpenAI
+    client at the proxy. (Reference: application_builders.build_openai_app.)
+    """
+    from ray_tpu import serve
+
+    dep = serve.deployment(
+        OpenAIServer,
+        name=name or f"OpenAI:{llm_config.get('model', 'model')}",
+        num_replicas=num_replicas,
+        ray_actor_options={"num_cpus": 1.0, "num_tpus": num_tpus},
+        max_ongoing_requests=int(llm_config.get("max_ongoing_requests", 32)),
+    )
+    return dep.bind(llm_config)

@@ -54,7 +54,19 @@ class LLMServer:
     def __init__(self, llm_config: Dict[str, Any]):
         self.model, self.params = load_model_and_params(llm_config)
         eng_cfg = EngineConfig(**(llm_config.get("engine_config") or {}))
-        self.engine = LLMEngine(self.model, self.params, eng_cfg)
+        mesh = llm_config.get("mesh")
+        tp = int(llm_config.get("tensor_parallel_size") or 1)
+        if mesh is None and tp > 1:
+            # TP over the first tp local devices (reference forwards
+            # tensor_parallel_size into vLLM, vllm_models.py:125-139; here
+            # the engine itself shards over the mesh).
+            import jax
+
+            from ray_tpu.parallel.mesh import create_mesh
+
+            mesh = create_mesh({"tensor": tp},
+                               devices=jax.devices()[:tp])
+        self.engine = LLMEngine(self.model, self.params, eng_cfg, mesh=mesh)
         self._queues: Dict[str, "queue.Queue"] = {}
         self._lock = threading.Lock()
         self._pending: "queue.Queue" = queue.Queue()

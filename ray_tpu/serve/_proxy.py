@@ -156,15 +156,16 @@ class ProxyActor:
             "body": payload,
             "headers": headers,
         }
-        stream = headers.get("x-serve-stream", "").lower() in ("1", "true")
+        # Streaming: the x-serve-stream header, or OpenAI-style
+        # {"stream": true} in a JSON body.
+        stream = (headers.get("x-serve-stream", "").lower() in ("1", "true")
+                  or (isinstance(payload, dict)
+                      and payload.get("stream") is True))
         loop = asyncio.get_running_loop()
         try:
             if stream:
                 gen = await loop.run_in_executor(
                     None, lambda: handle.options(stream=True).remote(request))
-                writer.write(
-                    b"HTTP/1.1 200 OK\r\ncontent-type: application/json\r\n"
-                    b"transfer-encoding: chunked\r\n\r\n")
                 it = iter(gen)
                 _END = object()
 
@@ -174,24 +175,47 @@ class ProxyActor:
                     except StopIteration:
                         return _END
 
-                while True:
-                    # One executor hop per item: the generator's blocking
-                    # ray.get must stay off this event loop.
-                    item = await loop.run_in_executor(None, _next)
-                    if item is _END:
-                        break
-                    chunk = (json.dumps(item, default=str) + "\n").encode()
+                # Peek the first item: a {"__http__": {...}} envelope lets
+                # the deployment pick the response content-type (SSE for
+                # OpenAI-compatible endpoints).
+                first = await loop.run_in_executor(None, _next)
+                ctype = b"application/json"
+                if isinstance(first, dict) and "__http__" in first:
+                    ctype = str(first["__http__"].get(
+                        "content_type", "application/json")).encode()
+                    first = await loop.run_in_executor(None, _next)
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\ncontent-type: " + ctype +
+                    b"\r\ntransfer-encoding: chunked\r\n\r\n")
+                item = first
+                while item is not _END:
+                    # str items go out verbatim (pre-formatted SSE frames);
+                    # anything else ships as a JSON line. One executor hop
+                    # per item: the generator's blocking ray.get must stay
+                    # off this event loop.
+                    if isinstance(item, str):
+                        chunk = item.encode()
+                    else:
+                        chunk = (json.dumps(item, default=str) + "\n").encode()
                     writer.write(hex(len(chunk))[2:].encode() + b"\r\n"
                                  + chunk + b"\r\n")
                     await writer.drain()
+                    item = await loop.run_in_executor(None, _next)
                 writer.write(b"0\r\n\r\n")
                 await writer.drain()
                 return True
             resp = await loop.run_in_executor(
                 None, lambda: handle.remote(request).result(timeout=120))
+            status = 200
+            ctype = b"application/json"
+            if isinstance(resp, dict) and "__http__" in resp:
+                meta = resp["__http__"]
+                status = int(meta.get("status", 200))
+                ctype = str(meta.get(
+                    "content_type", "application/json")).encode()
+                resp = resp.get("body")
             data = json.dumps(resp, default=str).encode()
-            await self._respond(writer, 200, data,
-                                ctype=b"application/json")
+            await self._respond(writer, status, data, ctype=ctype)
             return True
         except Exception as e:
             logger.exception("request failed")
