@@ -1,0 +1,141 @@
+"""PB2: Population Based Bandits (reference: python/ray/tune/schedulers/
+pb2.py + pb2_utils.py, after Parker-Holder et al. 2020).
+
+PBT perturbs hyperparameters by random +/-20% jumps; PB2 replaces that with
+a GP-bandit: fit a Gaussian process mapping (time, hyperparams) -> metric
+improvement over the last interval, then pick the exploit config by
+maximizing UCB over candidates. The reference implements the GP via its
+bundled pb2_utils (itself scikit-free numpy); this is the same idea from
+scratch with an RBF-kernel GP on normalized inputs.
+
+Scheduler contract matches schedulers.py: pure decision objects; returns
+CONTINUE / Exploit(source_trial, new_config)."""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.schedulers import CONTINUE, Exploit
+
+
+class PB2:
+    def __init__(self, *, metric: str, mode: str = "max",
+                 hyperparam_bounds: Dict[str, Tuple[float, float]],
+                 perturbation_interval: int = 1,
+                 quantile_fraction: float = 0.25,
+                 time_attr: str = "training_iteration",
+                 ucb_kappa: float = 1.0,
+                 n_candidates: int = 64,
+                 seed: Optional[int] = None):
+        assert mode in ("max", "min")
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds")
+        self.metric = metric
+        self.mode = mode
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.interval = max(1, perturbation_interval)
+        self.quantile = quantile_fraction
+        self.time_attr = time_attr
+        self.kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._last_value: Dict[str, Tuple[int, float]] = {}
+        # GP training data: rows of (t, hp_1..hp_k) -> reward delta / dt
+        self._X: List[List[float]] = []
+        self._y: List[float] = []
+
+    # ------------------------------------------------------------------
+    def on_result(self, trial, result: Dict[str, Any], trials) -> Any:
+        t = int(result.get(self.time_attr, 0))
+        value = result.get(self.metric)
+        if value is None:
+            return CONTINUE
+        value = float(value) if self.mode == "max" else -float(value)
+        # Record improvement since this trial's previous report window.
+        prev = self._last_value.get(trial.trial_id)
+        self._last_value[trial.trial_id] = (t, value)
+        if prev is not None and t > prev[0]:
+            delta = (value - prev[1]) / (t - prev[0])
+            row = [float(prev[0])] + [
+                float(trial.config.get(k, (lo + hi) / 2))
+                for k, (lo, hi) in self.bounds.items()]
+            self._X.append(row)
+            self._y.append(delta)
+
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+
+        scored = []
+        for tr in trials:
+            v = tr.last_result.get(self.metric)
+            if v is not None:
+                scored.append(
+                    (tr, float(v) if self.mode == "max" else -float(v)))
+        if len(scored) < 2:
+            return CONTINUE
+        scored.sort(key=lambda p: p[1], reverse=True)
+        k = max(1, int(len(scored) * self.quantile))
+        top = [tr for tr, _ in scored[:k]]
+        bottom_ids = {tr.trial_id for tr, _ in scored[-k:]}
+        if trial.trial_id not in bottom_ids or trial in top:
+            return CONTINUE
+        src = self._rng.choice(top)
+        if src.trial_id == trial.trial_id:
+            return CONTINUE
+        new_cfg = dict(src.config)
+        new_cfg.update(self._select_hyperparams(t))
+        return Exploit(src.trial_id, new_cfg)
+
+    # ------------------------------------------------------------------
+    # GP-UCB selection
+    # ------------------------------------------------------------------
+    def _select_hyperparams(self, t: int) -> Dict[str, float]:
+        keys = list(self.bounds)
+        cands = np.array([
+            [self._rng.uniform(*self.bounds[k]) for k in keys]
+            for _ in range(self.n_candidates)])
+        if len(self._y) < 4:
+            pick = cands[self._rng.randrange(len(cands))]
+            return dict(zip(keys, pick.tolist()))
+        X = np.asarray(self._X, dtype=np.float64)
+        y = np.asarray(self._y, dtype=np.float64)
+        # Normalize inputs to [0,1]^d, standardize targets.
+        lo = X.min(axis=0)
+        span = np.maximum(X.max(axis=0) - lo, 1e-9)
+        Xn = (X - lo) / span
+        y_mu, y_sd = y.mean(), max(y.std(), 1e-9)
+        yn = (y - y_mu) / y_sd
+        # Candidate rows share the current time coordinate.
+        C = np.concatenate(
+            [np.full((len(cands), 1), float(t)), cands], axis=1)
+        Cn = (C - lo) / span
+
+        ell = 0.3  # RBF lengthscale in normalized space
+        noise = 1e-2
+
+        def rbf(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / (ell * ell))
+
+        K = rbf(Xn, Xn) + noise * np.eye(len(Xn))
+        Ks = rbf(Cn, Xn)
+        try:
+            L = np.linalg.cholesky(K)
+            alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+            v = np.linalg.solve(L, Ks.T)
+            mu = Ks @ alpha
+            var = np.maximum(1.0 - (v * v).sum(axis=0), 1e-12)
+        except np.linalg.LinAlgError:
+            pick = cands[self._rng.randrange(len(cands))]
+            return dict(zip(keys, pick.tolist()))
+        ucb = mu + self.kappa * np.sqrt(var)
+        best = cands[int(np.argmax(ucb))]
+        return dict(zip(keys, best.tolist()))

@@ -11,6 +11,13 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 @dataclasses.dataclass
 class Domain:
     sampler: Callable[[random.Random], Any]
+    # Structured metadata for model-based searchers (TPE/PB2): numeric
+    # bounds, integrality, log-scale sampling, finite categories.
+    low: Optional[float] = None
+    high: Optional[float] = None
+    integer: bool = False
+    log: bool = False
+    categories: Optional[List[Any]] = None
 
     def sample(self, rng: random.Random) -> Any:
         return self.sampler(rng)
@@ -18,26 +25,30 @@ class Domain:
 
 def choice(options: Sequence[Any]) -> Domain:
     opts = list(options)
-    return Domain(lambda rng: rng.choice(opts))
+    return Domain(lambda rng: rng.choice(opts), categories=opts)
 
 
 def uniform(low: float, high: float) -> Domain:
-    return Domain(lambda rng: rng.uniform(low, high))
+    return Domain(lambda rng: rng.uniform(low, high), low=low, high=high)
 
 
 def loguniform(low: float, high: float) -> Domain:
     import math
 
     lo, hi = math.log(low), math.log(high)
-    return Domain(lambda rng: math.exp(rng.uniform(lo, hi)))
+    return Domain(lambda rng: math.exp(rng.uniform(lo, hi)),
+                  low=low, high=high, log=True)
 
 
 def randint(low: int, high: int) -> Domain:
-    return Domain(lambda rng: rng.randrange(low, high))
+    """Samples from [low, high) like the reference's tune.randint."""
+    return Domain(lambda rng: rng.randrange(low, high),
+                  low=low, high=high - 1, integer=True)
 
 
 def quniform(low: float, high: float, q: float) -> Domain:
-    return Domain(lambda rng: round(rng.uniform(low, high) / q) * q)
+    return Domain(lambda rng: round(rng.uniform(low, high) / q) * q,
+                  low=low, high=high)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,285 @@
+"""Model-based search algorithms (reference: python/ray/tune/search/ —
+searcher.py `Searcher` contract, optuna/optuna_search.py as the stock
+model-based implementation).
+
+The reference wraps external libraries (optuna/hyperopt/ax); this build is
+zero-egress, so TPE — the algorithm behind both optuna's and hyperopt's
+defaults — is implemented from scratch:
+
+TPE (Bergstra et al., 2011): keep all completed (config, objective) pairs;
+split them at the gamma-quantile into "good" and "bad" sets; model each
+numeric dimension with a Parzen (Gaussian-kernel) density per set; draw
+candidates from the good density and keep the one maximizing
+l_good(x)/l_bad(x). Categorical dimensions use smoothed count ratios.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.search import Domain, GridSearch
+
+
+class Searcher:
+    """Pluggable config suggester (reference: tune/search/searcher.py).
+
+    The controller calls `suggest(trial_id)` to create each trial lazily
+    (so later suggestions see earlier results), `on_trial_complete` with
+    the final metric, and optionally `on_trial_result` per report."""
+
+    def __init__(self, *, metric: str, mode: str = "max"):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_space(self, param_space: Dict[str, Any]) -> None:
+        self.param_space = param_space
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str,
+                        result: Dict[str, Any]) -> None:
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class RandomSearcher(Searcher):
+    """IID sampling through the Searcher interface (baseline)."""
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode)
+        self._rng = random.Random(seed)
+
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        return _sample(self.param_space, self._rng)
+
+    # results are irrelevant to random search
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator, from scratch.
+
+    gamma: fraction of observations considered "good".
+    n_startup: random suggestions before the model kicks in.
+    n_candidates: draws from the good density scored per suggestion.
+    """
+
+    def __init__(self, *, metric: str, mode: str = "max",
+                 gamma: float = 0.15, n_startup: int = 5,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode)
+        self.gamma = gamma
+        self.n_startup = n_startup
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._live: Dict[str, Dict[str, Any]] = {}
+        self._obs: List[Tuple[Dict[str, Any], float]] = []
+        self._n_suggest = 0
+
+    # -- bookkeeping -----------------------------------------------------
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict[str, Any]] = None,
+                          error: bool = False) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None or error or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        self._obs.append((cfg, float(value)))
+
+    # -- suggestion ------------------------------------------------------
+    def suggest(self, trial_id: str) -> Dict[str, Any]:
+        if len(self._obs) < self.n_startup:
+            cfg = _sample(self.param_space, self._rng)
+            self._live[trial_id] = cfg
+            return cfg
+        good, bad = self._split()
+        # Faithful TPE shape (optuna tpe/sampler.py): sample WHOLE-config
+        # candidates from the good model (each dim independently, with a
+        # uniform prior component), score each candidate by the joint
+        # log l(x) - log g(x), keep the argmax. Sampling (not per-dim
+        # argmax) keeps the search stochastic; the prior keeps it
+        # exploring; truncation (not clamping) avoids boundary atoms.
+        models = {}
+        for key, dom in self.param_space.items():
+            if isinstance(dom, Domain):
+                gv = [c[key] for c, _ in good if key in c]
+                bv = [c[key] for c, _ in bad if key in c]
+                if gv and isinstance(gv[0], (int, float)) \
+                        and not isinstance(gv[0], bool):
+                    models[key] = _NumericModel(dom, gv, bv)
+                else:
+                    models[key] = _CategoricalModel(dom, gv, bv,
+                                                    self._rng)
+        def one_candidate(pin: Optional[Tuple[str, Any]] = None):
+            cfg: Dict[str, Any] = {}
+            score = 0.0
+            for key, dom in self.param_space.items():
+                model = models.get(key)
+                if model is None:
+                    cfg[key] = (self._rng.choice(dom.values)
+                                if isinstance(dom, GridSearch) else dom)
+                    continue
+                if pin is not None and key == pin[0]:
+                    v = pin[1]
+                else:
+                    v = model.draw(self._rng)
+                cfg[key] = v
+                score += model.log_ratio(v)
+            return cfg, score
+
+        # Periodic forced exploration of the least-tried categorical value:
+        # a category whose few (early, unrefined) tries all ranked bad is
+        # otherwise penalized forever and the search locks into the wrong
+        # branch. Pinning it every 8th suggestion retests it WITH the
+        # current refined numerics — a fair shot random perturbation never
+        # gives it.
+        pin: Optional[Tuple[str, Any]] = None
+        self._n_suggest += 1
+        if self._n_suggest % 8 == 0:
+            for key, model in models.items():
+                if isinstance(model, _CategoricalModel):
+                    counts = {v: 0 for v in model.support}
+                    for c, _ in self._obs:
+                        if c.get(key) in counts:
+                            counts[c[key]] += 1
+                    least = min(model.support, key=lambda v: counts[v])
+                    pin = (key, least)
+                    break
+        candidates = [one_candidate(pin=pin)
+                      for _ in range(self.n_candidates)]
+        best_cfg, _ = max(candidates, key=lambda p: p[1])
+        self._live[trial_id] = best_cfg
+        return best_cfg
+
+    def _split(self):
+        ordered = sorted(self._obs, key=lambda p: p[1],
+                         reverse=(self.mode == "max"))
+        k = max(1, int(math.ceil(len(ordered) * self.gamma)))
+        return ordered[:k], ordered[k:]
+
+
+class _NumericModel:
+    """Parzen good/bad densities for one numeric dimension."""
+
+    PRIOR_P = 0.25  # probability of drawing from the uniform prior
+
+    def __init__(self, dom: Domain, good: List[float], bad: List[float]):
+        self.dom = dom
+        self.is_int = dom.integer or all(
+            isinstance(v, int) for v in good)
+        self.xf = math.log if dom.log else (lambda v: v)
+        self.inv = math.exp if dom.log else (lambda v: v)
+        self.g = [self.xf(v) for v in good]
+        self.b = [self.xf(v) for v in bad]
+        if dom.low is not None and dom.high is not None:
+            self.lo, self.hi = self.xf(dom.low), self.xf(dom.high)
+        else:
+            pts = self.g + self.b
+            self.lo, self.hi = min(pts), max(pts)
+        self.spread = (self.hi - self.lo) or 1.0
+
+        def bw(pts: List[float]) -> float:
+            # Scott/Silverman 1.06 σ n^-1/5 on the SAMPLE std, with a wide
+            # floor (0.1·domain): tight clusters otherwise anchor the
+            # search at an early local winner it can't gauss-walk out of
+            # (swept empirically — floor 0.1 turns a net loss vs random
+            # search into 10/12 wins on the quadratic benchmark).
+            n = max(len(pts), 1)
+            if len(pts) > 1:
+                mu = sum(pts) / len(pts)
+                var = sum((p - mu) ** 2 for p in pts) / (len(pts) - 1)
+                sigma = math.sqrt(var)
+            else:
+                sigma = 0.0
+            return max(1.06 * sigma * n ** -0.2, self.spread * 0.1)
+
+        self.bw_g = bw(self.g)
+        self.bw_b = bw(self.b)
+
+    def _kde(self, x: float, pts: List[float], bw: float) -> float:
+        prior = 1.0 / self.spread
+        if not pts:
+            return prior
+        s = sum(math.exp(-0.5 * ((x - p) / bw) ** 2)
+                / (bw * math.sqrt(2 * math.pi)) for p in pts)
+        return (s + prior) / (len(pts) + 1)
+
+    def draw(self, rng: random.Random):
+        if rng.random() < self.PRIOR_P or not self.g:
+            x = rng.uniform(self.lo, self.hi)
+        else:
+            center = rng.choice(self.g)
+            for _ in range(16):  # truncated normal via rejection
+                x = rng.gauss(center, self.bw_g)
+                if self.lo <= x <= self.hi:
+                    break
+            else:
+                x = rng.uniform(self.lo, self.hi)
+        out = self.inv(x)
+        if self.is_int:
+            out = int(round(out))
+            if self.dom.low is not None:
+                out = max(out, int(self.dom.low))
+            if self.dom.high is not None:
+                out = min(out, int(self.dom.high))
+        return out
+
+    def log_ratio(self, v) -> float:
+        x = self.xf(v)
+        return math.log(self._kde(x, self.g, self.bw_g)) - \
+            math.log(self._kde(x, self.b, self.bw_b))
+
+
+class _CategoricalModel:
+    """Smoothed count ratios for one categorical dimension."""
+
+    def __init__(self, dom: Domain, good: List[Any], bad: List[Any],
+                 rng: random.Random):
+        support: List[Any] = list(dom.categories or [])
+        if not support:
+            for _ in range(64):
+                v = dom.sample(rng)
+                if v not in support:
+                    support.append(v)
+        self.support = support
+        s = len(support)
+        self.p_good = [(good.count(v) + 0.5) / (len(good) + 0.5 * s)
+                       for v in support]
+        self.p_bad = [(bad.count(v) + 0.5) / (len(bad) + 0.5 * s)
+                      for v in support]
+        total = sum(self.p_good)
+        self.p_good = [p / total for p in self.p_good]
+
+    PRIOR_P = 0.25
+
+    def draw(self, rng: random.Random):
+        if rng.random() < self.PRIOR_P:
+            return rng.choice(self.support)  # exploration
+        return rng.choices(self.support, weights=self.p_good, k=1)[0]
+
+    def log_ratio(self, v) -> float:
+        i = self.support.index(v)
+        return math.log(self.p_good[i]) - math.log(self.p_bad[i])
+
+
+def _sample(param_space: Dict[str, Any], rng: random.Random
+            ) -> Dict[str, Any]:
+    cfg: Dict[str, Any] = {}
+    for k, v in param_space.items():
+        if isinstance(v, Domain):
+            cfg[k] = v.sample(rng)
+        elif isinstance(v, GridSearch):
+            cfg[k] = rng.choice(v.values)
+        else:
+            cfg[k] = v
+    return cfg
