@@ -1,0 +1,137 @@
+"""Behavior Cloning (reference: rllib/algorithms/bc/bc.py — BC trains the
+policy head with negative log-likelihood over logged actions, reading
+batches through the offline data plane).
+
+TPU-first: one jitted update step (cross-entropy over the RLModule's policy
+logits), data via ray_tpu.data parquet (offline.py OfflineData)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.offline import OfflineData
+from ray_tpu.rllib.rl_module import RLModule
+
+
+@dataclasses.dataclass
+class BCLearnerConfig:
+    lr: float = 1e-3
+    batch_size: int = 256
+    num_epochs: int = 4
+
+
+class BCConfig:
+    """Builder-style config (reference: bc.py BCConfig)."""
+
+    def __init__(self):
+        self._obs_dim: Optional[int] = None
+        self._num_actions: Optional[int] = None
+        self._input_path: Optional[str] = None
+        self._dataset: Any = None
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.learner = BCLearnerConfig()
+
+    def environment(self, *, obs_dim: int, num_actions: int) -> "BCConfig":
+        self._obs_dim = obs_dim
+        self._num_actions = num_actions
+        return self
+
+    def offline_data(self, input_path: Optional[str] = None, *,
+                     dataset: Any = None) -> "BCConfig":
+        self._input_path = input_path
+        self._dataset = dataset
+        return self
+
+    def training(self, *, lr: Optional[float] = None,
+                 train_batch_size: Optional[int] = None,
+                 num_epochs: Optional[int] = None) -> "BCConfig":
+        if lr is not None:
+            self.learner.lr = lr
+        if train_batch_size is not None:
+            self.learner.batch_size = train_batch_size
+        if num_epochs is not None:
+            self.learner.num_epochs = num_epochs
+        return self
+
+    def build(self) -> "BC":
+        assert self._obs_dim and self._num_actions, "call .environment()"
+        assert self._input_path or self._dataset is not None, \
+            "call .offline_data()"
+        return BC(self)
+
+
+class BC:
+    def __init__(self, config: BCConfig):
+        self.config = config
+        self.module = RLModule(config._obs_dim, config._num_actions,
+                               config.hidden)
+        self.params = self.module.init_params(
+            jax.random.PRNGKey(config.seed))
+        self.data = OfflineData(config._dataset
+                                if config._dataset is not None
+                                else config._input_path)
+        tx = optax.adam(config.learner.lr)
+        self._tx = tx
+        self.opt_state = tx.init(self.params)
+        net = self.module.net
+
+        def loss_fn(params, obs, actions):
+            logits, _ = net.apply({"params": params}, obs)
+            logp = jax.nn.log_softmax(logits)
+            nll = -logp[jnp.arange(logits.shape[0]), actions]
+            return nll.mean()
+
+        def update(params, opt_state, obs, actions):
+            loss, grads = jax.value_and_grad(loss_fn)(params, obs, actions)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+        self._epoch = 0
+
+    def train(self) -> Dict[str, Any]:
+        """One pass over the offline dataset (reference:
+        Algorithm.train() iteration contract)."""
+        cfg = self.config.learner
+        losses = []
+        for batch in self.data.iter_train_batches(
+                batch_size=cfg.batch_size, num_epochs=1,
+                seed=self.config.seed + self._epoch):
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state,
+                jnp.asarray(batch["obs"]),
+                jnp.asarray(batch["action"].astype(np.int32)))
+            losses.append(float(loss))
+        self._epoch += 1
+        return {"training_iteration": self._epoch,
+                "loss": float(np.mean(losses)) if losses else None,
+                "num_batches": len(losses)}
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        logits, _ = self.module.forward_train(
+            self.params, jnp.asarray(np.atleast_2d(obs)))
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    def evaluate(self, env_fn: Callable, *, n_episodes: int = 10,
+                 max_steps: int = 500, seed: int = 1000) -> Dict[str, Any]:
+        env = env_fn()
+        returns = []
+        for ep in range(n_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            total = 0.0
+            for _ in range(max_steps):
+                a = int(self.compute_actions(np.asarray(obs))[0])
+                obs, rew, term, trunc, _ = env.step(a)
+                total += float(rew)
+                if term or trunc:
+                    break
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns)),
+                "episodes": n_episodes}

@@ -1,0 +1,170 @@
+"""CQL — Conservative Q-Learning for discrete actions (reference:
+rllib/algorithms/cql/cql.py; Kumar et al. 2020).
+
+Offline Q-learning diverges because the bootstrap maximizes over actions
+the dataset never took; CQL adds a conservative penalty
+logsumexp(Q(s,·)) − Q(s, a_data) that pushes unseen-action Q-values down.
+Discrete CQL(H) over a double-Q MLP, one jitted update, data through the
+same ray_tpu.data-backed OfflineData as BC."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.offline import OfflineData
+
+
+class _QNet(nn.Module):
+    num_actions: int
+    hidden: Sequence[int]
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        return nn.Dense(self.num_actions)(x)
+
+
+@dataclasses.dataclass
+class CQLLearnerConfig:
+    lr: float = 3e-4
+    batch_size: int = 256
+    gamma: float = 0.99
+    cql_alpha: float = 1.0       # weight of the conservative penalty
+    target_update_every: int = 100
+
+
+class CQLConfig:
+    def __init__(self):
+        self._obs_dim: Optional[int] = None
+        self._num_actions: Optional[int] = None
+        self._input_path: Optional[str] = None
+        self._dataset: Any = None
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.learner = CQLLearnerConfig()
+
+    def environment(self, *, obs_dim: int, num_actions: int) -> "CQLConfig":
+        self._obs_dim = obs_dim
+        self._num_actions = num_actions
+        return self
+
+    def offline_data(self, input_path: Optional[str] = None, *,
+                     dataset: Any = None) -> "CQLConfig":
+        self._input_path = input_path
+        self._dataset = dataset
+        return self
+
+    def training(self, *, lr: Optional[float] = None,
+                 train_batch_size: Optional[int] = None,
+                 cql_alpha: Optional[float] = None,
+                 gamma: Optional[float] = None) -> "CQLConfig":
+        if lr is not None:
+            self.learner.lr = lr
+        if train_batch_size is not None:
+            self.learner.batch_size = train_batch_size
+        if cql_alpha is not None:
+            self.learner.cql_alpha = cql_alpha
+        if gamma is not None:
+            self.learner.gamma = gamma
+        return self
+
+    def build(self) -> "CQL":
+        assert self._obs_dim and self._num_actions, "call .environment()"
+        assert self._input_path or self._dataset is not None, \
+            "call .offline_data()"
+        return CQL(self)
+
+
+class CQL:
+    def __init__(self, config: CQLConfig):
+        self.config = config
+        cfg = config.learner
+        self.net = _QNet(config._num_actions, tuple(config.hidden))
+        rng = jax.random.PRNGKey(config.seed)
+        sample = jnp.zeros((1, config._obs_dim))
+        self.params = self.net.init(rng, sample)["params"]
+        self.target_params = self.params
+        self.data = OfflineData(config._dataset
+                                if config._dataset is not None
+                                else config._input_path)
+        tx = optax.adam(cfg.lr)
+        self._tx = tx
+        self.opt_state = tx.init(self.params)
+        net, gamma, alpha = self.net, cfg.gamma, cfg.cql_alpha
+
+        def loss_fn(params, target_params, obs, actions, rewards,
+                    next_obs, dones):
+            q = net.apply({"params": params}, obs)          # [B, A]
+            q_data = q[jnp.arange(q.shape[0]), actions]
+            q_next = net.apply({"params": target_params}, next_obs)
+            target = rewards + gamma * (1.0 - dones) * q_next.max(-1)
+            bellman = jnp.square(q_data - jax.lax.stop_gradient(target))
+            # CQL(H): push down logsumexp Q, push up the logged action's Q.
+            conservative = jax.nn.logsumexp(q, axis=-1) - q_data
+            return (0.5 * bellman + alpha * conservative).mean()
+
+        def update(params, target_params, opt_state, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, target_params, batch["obs"], batch["action"],
+                batch["reward"], batch["next_obs"], batch["done"])
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+        self._fwd = jax.jit(lambda p, o: net.apply({"params": p}, o))
+        self._steps = 0
+        self._epoch = 0
+
+    def train(self) -> Dict[str, Any]:
+        cfg = self.config.learner
+        losses = []
+        for batch in self.data.iter_train_batches(
+                batch_size=cfg.batch_size, num_epochs=1,
+                seed=self.config.seed + self._epoch):
+            jb = {
+                "obs": jnp.asarray(batch["obs"]),
+                "action": jnp.asarray(batch["action"].astype(np.int32)),
+                "reward": jnp.asarray(batch["reward"]),
+                "next_obs": jnp.asarray(batch["next_obs"]),
+                "done": jnp.asarray(batch["done"].astype(np.float32)),
+            }
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.target_params, self.opt_state, jb)
+            losses.append(float(loss))
+            self._steps += 1
+            if self._steps % cfg.target_update_every == 0:
+                self.target_params = self.params
+        self._epoch += 1
+        return {"training_iteration": self._epoch,
+                "loss": float(np.mean(losses)) if losses else None,
+                "num_batches": len(losses)}
+
+    def compute_actions(self, obs: np.ndarray) -> np.ndarray:
+        q = self._fwd(self.params, jnp.asarray(np.atleast_2d(obs)))
+        return np.asarray(jnp.argmax(q, axis=-1))
+
+    def evaluate(self, env_fn: Callable, *, n_episodes: int = 10,
+                 max_steps: int = 500, seed: int = 1000) -> Dict[str, Any]:
+        env = env_fn()
+        returns = []
+        for ep in range(n_episodes):
+            obs, _ = env.reset(seed=seed + ep)
+            total = 0.0
+            for _ in range(max_steps):
+                a = int(self.compute_actions(np.asarray(obs))[0])
+                obs, rew, term, trunc, _ = env.step(a)
+                total += float(rew)
+                if term or trunc:
+                    break
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns)),
+                "episodes": n_episodes}

@@ -1,0 +1,1 @@
+"""Example environments for tests and docs (reference: rllib/examples/)."""

@@ -1,0 +1,113 @@
+"""Offline RL data plane (reference: rllib/offline/ — offline_data.py
+`OfflineData` reads experiences through ray.data; offline_env_runner.py
+records them).
+
+Episodes are flat transition tables (obs / action / reward / next_obs /
+done columns) written as parquet through ray_tpu.data — the same
+"offline data rides the Data library" design as the reference."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ray_tpu import data as rt_data
+
+
+def record_episodes(env_fn: Callable, *, n_episodes: int = 50,
+                    policy: Optional[Callable] = None,
+                    seed: int = 0,
+                    max_steps: int = 500) -> Dict[str, np.ndarray]:
+    """Roll episodes and return a flat transition block. `policy(obs) ->
+    action` defaults to uniform-random (reference:
+    offline_env_runner.py sampling-to-disk)."""
+    env = env_fn()
+    rng = np.random.default_rng(seed)
+    cols: Dict[str, List[Any]] = {
+        "obs": [], "action": [], "reward": [], "next_obs": [],
+        "done": [], "episode_id": []}
+    for ep in range(n_episodes):
+        obs, _ = env.reset(seed=seed + ep)
+        for _ in range(max_steps):
+            if policy is not None:
+                action = int(policy(np.asarray(obs)))
+            else:
+                action = int(rng.integers(env.action_space.n))
+            nxt, rew, term, trunc, _ = env.step(action)
+            cols["obs"].append(np.asarray(obs, np.float32))
+            cols["action"].append(action)
+            cols["reward"].append(float(rew))
+            cols["next_obs"].append(np.asarray(nxt, np.float32))
+            cols["done"].append(bool(term or trunc))
+            cols["episode_id"].append(ep)
+            obs = nxt
+            if term or trunc:
+                break
+    return {
+        "obs": np.stack(cols["obs"]),
+        "action": np.asarray(cols["action"], np.int32),
+        "reward": np.asarray(cols["reward"], np.float32),
+        "next_obs": np.stack(cols["next_obs"]),
+        "done": np.asarray(cols["done"], np.bool_),
+        "episode_id": np.asarray(cols["episode_id"], np.int32),
+    }
+
+
+def write_offline_dataset(block: Dict[str, np.ndarray], path: str,
+                          *, rows_per_file: int = 4096) -> None:
+    """Persist a transition block as a parquet directory (read back with
+    ray_tpu.data.read_parquet — the reference stores offline experiences
+    the same way, rllib/offline/offline_data.py)."""
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    n = len(block["action"])
+    for i, start in enumerate(range(0, n, rows_per_file)):
+        sl = slice(start, min(start + rows_per_file, n))
+        table = pa.table({k: (list(v[sl]) if v.ndim > 1 else v[sl])
+                          for k, v in block.items()})
+        pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+
+class OfflineData:
+    """Reader half (reference: rllib/offline/offline_data.py): wraps a
+    ray_tpu.data Dataset of transitions and serves shuffled train batches."""
+
+    def __init__(self, dataset_or_path: Any):
+        if isinstance(dataset_or_path, str):
+            self.dataset = rt_data.read_parquet(dataset_or_path)
+        else:
+            self.dataset = dataset_or_path
+        self._cache: Optional[Dict[str, np.ndarray]] = None
+
+    def _table(self) -> Dict[str, np.ndarray]:
+        if self._cache is None:
+            blocks = list(self.dataset.iter_blocks())
+            out: Dict[str, np.ndarray] = {}
+            for key in blocks[0]:
+                vals = [b[key] for b in blocks]
+                arrs = [np.stack([np.asarray(r, np.float32) for r in v])
+                        if getattr(v, "dtype", None) == object
+                        else np.asarray(v) for v in vals]
+                out[key] = np.concatenate(arrs, axis=0)
+            self._cache = out
+        return self._cache
+
+    def num_transitions(self) -> int:
+        return len(self._table()["action"])
+
+    def iter_train_batches(self, *, batch_size: int, num_epochs: int = 1,
+                           seed: int = 0
+                           ) -> Iterator[Dict[str, np.ndarray]]:
+        table = self._table()
+        n = self.num_transitions()
+        rng = np.random.default_rng(seed)
+        for _ in range(num_epochs):
+            perm = rng.permutation(n)
+            for i in range(0, n - batch_size + 1, batch_size):
+                idx = perm[i:i + batch_size]
+                yield {k: v[idx] for k, v in table.items()}
