@@ -117,7 +117,9 @@ class Node:
                 sys.executable, "-m", "ray_tpu.core.gcs",
                 "--host", "127.0.0.1", "--port", str(gcs_port),
                 "--persist-path",
-                os.path.join(self.session_dir, "gcs_snapshot.pkl"),
+                # sqlite → row-wise incremental writes (core/store_client.py);
+                # a .pkl path selects the whole-snapshot pickle backend.
+                os.path.join(self.session_dir, "gcs_store.sqlite"),
             ]
             self._gcs_proc = self._start_process(self._gcs_cmd, "gcs")
             _wait_port(*self.gcs_address)

@@ -167,9 +167,13 @@ def remote(*args, **options) -> Union[RemoteFunction, ActorClass]:
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
         *, timeout: Optional[float] = None) -> Any:
     # Channel-mode compiled-DAG outputs carry their own blocking read
-    # (reference: CompiledDAGRef supports ray.get).
-    if hasattr(refs, "get") and type(refs).__name__ == "CompiledDAGRef":
+    # (reference: CompiledDAGRef supports ray.get, alone or in lists).
+    if type(refs).__name__ == "CompiledDAGRef":
         return refs.get(timeout)
+    if (isinstance(refs, (list, tuple)) and refs
+            and any(type(r).__name__ == "CompiledDAGRef" for r in refs)):
+        return [r.get(timeout) if type(r).__name__ == "CompiledDAGRef"
+                else get(r, timeout=timeout) for r in refs]
     if _global_client is not None:
         return _global_client.get(refs, timeout=timeout)
     w = worker_mod.global_worker()

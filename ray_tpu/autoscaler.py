@@ -182,3 +182,90 @@ class Autoscaler:
             except Exception:
                 logger.exception("autoscaler update failed")
             self._stop.wait(self.interval_s)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "provider_nodes": len(self.provider.nodes()),
+            "min_workers": self.min_workers,
+            "max_workers": self.max_workers,
+        }
+
+
+class _HeadRef:
+    """Duck-typed head handle for providers that only need the GCS address
+    and shared session dir (the CLI's `cluster-up` path, where the head
+    node lives in another process)."""
+
+    def __init__(self, gcs_address, session_dir: str):
+        self.gcs_address = tuple(gcs_address)
+        self.session_dir = session_dir
+
+
+def autoscaler_from_yaml(path: str) -> Autoscaler:
+    """Build and START an autoscaler from a cluster YAML (reference:
+    `ray up` + autoscaler config YAML, python/ray/autoscaler/ray-schema):
+
+        address: 127.0.0.1:6379         # GCS (default: recorded cluster)
+        session_dir: /tmp/ray_tpu/...   # default: recorded cluster
+        min_workers: 0
+        max_workers: 4
+        idle_timeout_s: 60
+        provider:
+          type: local | tpu-pod-fake
+          resources: {CPU: 2}           # local: per-node resources
+          accelerator_type: v5e-8       # tpu-pod-fake
+          hosts_per_slice: 2
+          chips_per_host: 4
+
+    The caller must already be (or become) a connected driver: demand is
+    read through the state API.
+    """
+    import yaml
+
+    import ray_tpu
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    address = cfg.get("address")
+    session_dir = cfg.get("session_dir")
+    if not address or not session_dir:
+        from ray_tpu.scripts.cli import _read_cluster_file
+
+        for entry in reversed(_read_cluster_file()):
+            if entry.get("head"):
+                address = address or "{}:{}".format(*entry["gcs_address"])
+                session_dir = session_dir or entry["session_dir"]
+                break
+    if not address:
+        raise ValueError("cluster YAML needs `address` (or a recorded "
+                         "cluster from `start --head`)")
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=address)
+    host, _, port = address.rpartition(":")
+    head = _HeadRef((host, int(port)), session_dir or "/tmp/ray_tpu")
+    pcfg = dict(cfg.get("provider") or {"type": "local"})
+    ptype = pcfg.pop("type", "local")
+    if ptype == "local":
+        provider: NodeProvider = LocalNodeProvider(
+            head, default_resources=pcfg.get("resources"))
+    elif ptype in ("tpu-pod-fake", "tpu-pod"):
+        from ray_tpu.tpu_pod_provider import (
+            FakeTPUTransport,
+            TPUPodConfig,
+            TPUPodProvider,
+        )
+
+        pod_cfg = TPUPodConfig(
+            accelerator_type=pcfg.get("accelerator_type", "v5e-8"),
+            hosts_per_slice=int(pcfg.get("hosts_per_slice", 1)),
+            chips_per_host=int(pcfg.get("chips_per_host", 4)))
+        provider = TPUPodProvider(pod_cfg, FakeTPUTransport(head))
+    else:
+        raise ValueError(f"unknown provider type {ptype!r}")
+    scaler = Autoscaler(
+        provider,
+        min_workers=int(cfg.get("min_workers", 0)),
+        max_workers=int(cfg.get("max_workers", 4)),
+        idle_timeout_s=float(cfg.get("idle_timeout_s", 60.0)))
+    scaler.start()
+    return scaler

@@ -171,38 +171,70 @@ class PlacementGroupInfo:
         # bundle index -> node_id
         self.bundle_nodes: Dict[int, NodeID] = {}
 
+    def to_state(self) -> Dict[str, Any]:
+        return {
+            "pg_id": self.pg_id.binary(),
+            "bundles": self.bundles,
+            "strategy": self.strategy,
+            "name": self.name,
+            "state": self.state,
+            "bundle_nodes": {i: n.binary()
+                             for i, n in self.bundle_nodes.items()},
+        }
+
+    @staticmethod
+    def from_state(state: Dict[str, Any]) -> "PlacementGroupInfo":
+        info = PlacementGroupInfo(
+            PlacementGroupID(state["pg_id"]), state["bundles"],
+            state["strategy"], state["name"])
+        info.state = state["state"]
+        info.bundle_nodes = {int(i): NodeID(n)
+                             for i, n in state["bundle_nodes"].items()}
+        return info
+
 
 # ---------------------------------------------------------------------------
 # The server
 # ---------------------------------------------------------------------------
 class GcsStorage:
-    """File-backed table persistence (reference: gcs/store_client/
-    redis_store_client.h — there Redis enables GCS restart; here an atomic
-    pickle snapshot under the session dir does. Snapshots are debounced:
-    mutations mark dirty, a flush loop writes ≤1x per interval, and
-    shutdown flushes synchronously)."""
+    """Debounce layer over a pluggable StoreClient (reference:
+    gcs/store_client/ — store_client.h contract, redis_store_client.h for
+    external-store head-node FT). Backend by path: *.sqlite → row-wise
+    incremental sqlite (WAL), anything else → atomic whole-snapshot
+    pickle. Mutations mark dirty, a flush loop writes ≤1x per interval,
+    shutdown flushes synchronously."""
 
     def __init__(self, path: Optional[str]):
+        from ray_tpu.core.store_client import create_store_client
+
         self.path = path
         self.dirty = False
+        try:
+            self.client = create_store_client(path)
+        except Exception:
+            # A corrupt/garbage store file must not take down the control
+            # plane it exists to protect: set it aside and start fresh
+            # (same contract as an unreadable pickle snapshot).
+            logger.exception("GCS store unusable; starting fresh")
+            try:
+                os.replace(path, path + ".corrupt")
+                self.client = create_store_client(path)
+            except Exception:
+                self.client = None
 
     def load(self) -> Optional[Dict[str, Any]]:
-        if not self.path or not os.path.exists(self.path):
+        if self.client is None:
             return None
         try:
-            with open(self.path, "rb") as f:
-                return pickle.load(f)
+            return self.client.load()
         except Exception:
-            logger.exception("GCS snapshot unreadable; starting fresh")
+            logger.exception("GCS store unreadable; starting fresh")
             return None
 
     def save(self, tables: Dict[str, Any]) -> None:
-        if not self.path:
+        if self.client is None:
             return
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(tables, f, protocol=5)
-        os.replace(tmp, self.path)
+        self.client.save(tables)
         self.dirty = False
 
 
@@ -233,15 +265,23 @@ class GcsServer:
         if not snap:
             return
         self.kv = snap.get("kv", {})
-        self.jobs = snap.get("jobs", {})
+        self.jobs = {int(k): v for k, v in snap.get("jobs", {}).items()}
         self._job_counter = snap.get("job_counter", 0)
         self.named_actors = {n: ActorID(a)
                              for n, a in snap.get("named_actors", {}).items()}
-        for state in snap.get("actors", []):
+        actors = snap.get("actors", [])
+        # actors persist row-wise ({id_hex: state}) for incremental
+        # backends; accept the old list form for pre-existing snapshots.
+        states = actors.values() if isinstance(actors, dict) else actors
+        for state in states:
             info = ActorInfo.from_state(state)
             self.actors[info.actor_id] = info
-        logger.info("GCS restored %d actors, %d kv keys from snapshot",
-                    len(self.actors), len(self.kv))
+        for state in snap.get("placement_groups", {}).values():
+            pg = PlacementGroupInfo.from_state(state)
+            self.placement_groups[pg.pg_id] = pg
+        logger.info("GCS restored %d actors, %d pgs, %d kv keys",
+                    len(self.actors), len(self.placement_groups),
+                    len(self.kv))
 
     def mark_dirty(self) -> None:
         self.storage.dirty = True
@@ -249,11 +289,18 @@ class GcsServer:
     def _snapshot_tables(self) -> Dict[str, Any]:
         return {
             "kv": dict(self.kv),
-            "jobs": dict(self.jobs),
+            "jobs": {str(k): v for k, v in self.jobs.items()},
             "job_counter": self._job_counter,
             "named_actors": {n: a.binary()
                              for n, a in self.named_actors.items()},
-            "actors": [a.to_state() for a in self.actors.values()],
+            # row-wise so incremental backends rewrite only changed actors
+            "actors": {a.actor_id.hex(): a.to_state()
+                       for a in self.actors.values()},
+            # committed PGs survive a GCS restart (reference: PGs live in
+            # the Redis-backed store); nodelets re-report bundle holds via
+            # heartbeat reconciliation either way.
+            "placement_groups": {p.pg_id.hex(): p.to_state()
+                                 for p in self.placement_groups.values()},
         }
 
     async def _persist_loop(self) -> None:
@@ -680,9 +727,11 @@ class GcsServer:
         pgid = PlacementGroupID(pg_id)
         info = PlacementGroupInfo(pgid, bundles, strategy, name)
         self.placement_groups[pgid] = info
+        self.mark_dirty()
         ok = await self._schedule_pg(info)
         if ok:
             info.state = "CREATED"
+            self.mark_dirty()
             await self.pubsub.publish("placement_groups",
                                       {"event": "created", "pg_id": pg_id})
             return {"ok": True,
@@ -704,6 +753,7 @@ class GcsServer:
                     # scheduling race (membership check + bundle return).
                     if await self._schedule_pg(info):
                         info.state = "CREATED"
+                        self.mark_dirty()
                         await self.pubsub.publish(
                             "placement_groups",
                             {"event": "created",
@@ -786,6 +836,7 @@ class GcsServer:
         if info is None:
             return {"ok": False}
         info.state = "REMOVED"  # in-flight retry scheduling must not revive it
+        self.mark_dirty()
         for i, nid in info.bundle_nodes.items():
             try:
                 await self._nodelet(nid).call(

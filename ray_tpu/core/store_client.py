@@ -1,0 +1,165 @@
+"""Pluggable GCS table storage (reference: src/ray/gcs/store_client/ —
+store_client.h's AsyncPut/AsyncGetAll contract, redis_store_client.h for
+the external-store head-node FT story, in_memory_store_client.h).
+
+Two backends behind one interface:
+
+- FileStoreClient — single atomic pickle snapshot (the round-2 behavior).
+- SqliteStoreClient — one row per (table, key) in WAL-mode sqlite with
+  content-digest change tracking: a save() writes ONLY mutated rows, so
+  large stable tables (kv, actor registry) don't get rewritten every
+  debounce tick the way a whole-snapshot pickle does.
+
+The GCS keeps its debounced save loop; the backend decides how much IO a
+save costs. Restart recovery reads everything back with load().
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class StoreClient:
+    """Table snapshot storage: save({table: rows}) / load() -> same."""
+
+    def save(self, tables: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class FileStoreClient(StoreClient):
+    """Atomic whole-snapshot pickle (tmp + rename)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, tables: Dict[str, Any]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(tables, f, protocol=5)
+        os.replace(tmp, self.path)
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        if not os.path.exists(self.path):
+            return None
+        try:
+            with open(self.path, "rb") as f:
+                return pickle.load(f)
+        except Exception:
+            logger.exception("GCS snapshot unreadable; starting fresh")
+            return None
+
+
+class SqliteStoreClient(StoreClient):
+    """Row-per-entry sqlite backend with incremental writes.
+
+    Tables whose rows are dicts persist row-wise (key -> pickled value);
+    scalar/list-valued tables persist as single rows under a reserved
+    key. WAL mode keeps the GCS event loop's write stalls short; the
+    digest cache means an unchanged row costs zero IO on save.
+    """
+
+    _SCALAR_KEY = "\x00scalar"
+
+    def __init__(self, path: str):
+        import sqlite3
+
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._db = sqlite3.connect(path)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS gcs (tbl TEXT, key TEXT, "
+            "value BLOB, PRIMARY KEY (tbl, key))")
+        self._db.commit()
+        self._digests: Dict[tuple, bytes] = {}
+
+    def save(self, tables: Dict[str, Any]) -> None:
+        cur = self._db.cursor()
+        seen = set()
+        # Digest updates are STAGED and applied only after a successful
+        # commit — recording them eagerly would mark rows clean that a
+        # mid-save failure left uncommitted, and no later save would ever
+        # retry them.
+        staged: Dict[tuple, Optional[bytes]] = {}
+        try:
+            for tbl, rows in tables.items():
+                if isinstance(rows, dict) and all(
+                        isinstance(k, str) for k in rows):
+                    items = rows.items()
+                else:
+                    items = [(self._SCALAR_KEY, rows)]
+                for key, value in items:
+                    blob = pickle.dumps(value, protocol=5)
+                    digest = hashlib.blake2b(blob, digest_size=16).digest()
+                    seen.add((tbl, key))
+                    if self._digests.get((tbl, key)) == digest:
+                        continue
+                    cur.execute(
+                        "INSERT OR REPLACE INTO gcs (tbl, key, value) "
+                        "VALUES (?, ?, ?)", (tbl, key, blob))
+                    staged[(tbl, key)] = digest
+            # Deletions: rows we tracked that vanished from the tables.
+            for (tbl, key) in list(self._digests):
+                if (tbl, key) not in seen:
+                    cur.execute("DELETE FROM gcs WHERE tbl=? AND key=?",
+                                (tbl, key))
+                    staged[(tbl, key)] = None
+            if staged:
+                self._db.commit()
+        except Exception:
+            try:
+                self._db.rollback()
+            except Exception:
+                pass
+            raise
+        for key, digest in staged.items():
+            if digest is None:
+                self._digests.pop(key, None)
+            else:
+                self._digests[key] = digest
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        cur = self._db.execute("SELECT tbl, key, value FROM gcs")
+        out: Dict[str, Any] = {}
+        any_rows = False
+        for tbl, key, blob in cur:
+            any_rows = True
+            value = pickle.loads(blob)
+            digest = hashlib.blake2b(blob, digest_size=16).digest()
+            self._digests[(tbl, key)] = digest
+            if key == self._SCALAR_KEY:
+                out[tbl] = value
+            else:
+                out.setdefault(tbl, {})[key] = value
+        return out if any_rows else None
+
+    def close(self) -> None:
+        try:
+            self._db.commit()
+            self._db.close()
+        except Exception:
+            pass
+
+
+def create_store_client(path: Optional[str]) -> Optional[StoreClient]:
+    """Backend selection by path: *.sqlite → SqliteStoreClient, anything
+    else → FileStoreClient, None → no persistence."""
+    if not path:
+        return None
+    if path.endswith(".sqlite") or path.endswith(".db"):
+        return SqliteStoreClient(path)
+    return FileStoreClient(path)

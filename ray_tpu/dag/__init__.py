@@ -90,18 +90,20 @@ class MultiOutputNode(DAGNode):
 
 class CompiledDAGRef:
     """Handle to one channel-mode execution's output (reference:
-    CompiledDAGRef, dag/compiled_dag_node.py). `ray_tpu.get` accepts it."""
+    CompiledDAGRef, dag/compiled_dag_node.py). `ray_tpu.get` accepts it
+    (single or in lists)."""
 
-    __slots__ = ("_dag", "_value", "_done")
+    __slots__ = ("_dag", "_seq", "_value", "_done")
 
-    def __init__(self, dag: "CompiledDAG"):
+    def __init__(self, dag: "CompiledDAG", seq: int):
         self._dag = dag
+        self._seq = seq
         self._value = None
         self._done = False
 
     def get(self, timeout: Optional[float] = None):
         if not self._done:
-            self._value = self._dag._read_output(timeout)
+            self._value = self._dag._collect_output(self._seq, timeout)
             self._done = True
         if isinstance(self._value, _DagChannelError):
             raise self._value.rebuild()
@@ -154,7 +156,10 @@ class CompiledDAG:
         self._executions = 0
         self._channels: List[Any] = []
         self._loop_refs: List[Any] = []
-        self._pending_ref: Optional[CompiledDAGRef] = None
+        self._exec_seq = 0
+        self._next_out_seq = 0
+        self._out_buffer: Dict[int, Any] = {}
+        self._inflight: List[CompiledDAGRef] = []
         self._channel_mode = False
         if enable_channels and self._is_linear_local_chain():
             try:
@@ -257,8 +262,15 @@ class CompiledDAG:
 
         return w.loop_thread.run(probe())
 
-    def _read_output(self, timeout: Optional[float] = None):
-        return self._channels[-1].read(timeout)
+    def _collect_output(self, seq: int, timeout: Optional[float] = None):
+        """Outputs arrive strictly in execute() order on the last channel;
+        buffer values for refs resolved out of order."""
+        while seq not in self._out_buffer:
+            value = self._channels[-1].read(timeout)
+            self._out_buffer[self._next_out_seq] = value
+            self._next_out_seq += 1
+        self._inflight = [r for r in self._inflight if r._seq != seq]
+        return self._out_buffer.pop(seq)
 
     def _teardown_channels(self) -> None:
         for ch in self._channels:
@@ -287,19 +299,25 @@ class CompiledDAG:
             input_val = input_args
         self._executions += 1
         if self._channel_mode:
-            # Single in-flight execution per compiled dag (single-slot
-            # channels): drain the previous output before overwriting the
-            # input slot. A previous execution's ERROR belongs to its own
-            # ref (already cached there) — it must not poison this one.
-            if self._pending_ref is not None:
-                prev, self._pending_ref = self._pending_ref, None
+            # Pipelined: the rings hold nslots values per edge; bound the
+            # in-flight window by draining the OLDEST ref when full (its
+            # error, if any, stays cached on that ref — it must not poison
+            # this execution).
+            limit = max(1, self._channels[0].nslots - 1)
+            while len(self._inflight) >= limit:
+                # Pop BEFORE get(): if the channel is closed (stage death),
+                # get() raises without touching _inflight and this loop
+                # must still make progress.
+                oldest = self._inflight.pop(0)
                 try:
-                    prev.get()
+                    oldest.get()
                 except Exception:  # noqa: BLE001
                     pass
-            self._channels[0].write(input_val)
-            self._pending_ref = CompiledDAGRef(self)
-            return self._pending_ref
+            self._channels[0].write(input_val, timeout=600.0)
+            ref = CompiledDAGRef(self, self._exec_seq)
+            self._exec_seq += 1
+            self._inflight.append(ref)
+            return ref
         results: Dict[int, Any] = {}
 
         def resolve(a):
@@ -325,7 +343,8 @@ class CompiledDAG:
 
     def teardown(self) -> None:
         if self._channel_mode:
-            self._pending_ref = None
+            self._inflight = []
+            self._out_buffer.clear()
             self._teardown_channels()
         self._order.clear()
         self._visited.clear()

@@ -21,7 +21,17 @@ import dataclasses
 import builtins
 import itertools
 _range = builtins.range
-from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterable,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -392,48 +402,186 @@ class Dataset:
         return len(self.materialize()._plan[0].refs)
 
     # -- reorganization ---------------------------------------------------
+    # All three exchange ops run as distributed map/reduce task DAGs: the
+    # driver routes ObjectRefs and small metadata (row counts, key
+    # samples), never block payloads (reference:
+    # data/_internal/execution/operators/hash_shuffle.py,
+    # planner/exchange/sort_task_spec.py). A one-block upstream keeps the
+    # trivial local path.
     def repartition(self, num_blocks: int) -> "Dataset":
-        full = block_concat(list(self.iter_blocks()))
-        n = block_num_rows(full)
-        per = max(1, -(-n // num_blocks))
+        """Split/merge exchange: input blocks are sliced at the global row
+        boundaries of the target layout, slices route to merge tasks."""
+        N = max(1, int(num_blocks))
+        plan = list(self._plan)
 
-        def gen(full=full, n=n, per=per):
-            for i in _range(0, n, per):
-                yield block_slice(full, i, min(i + per, n))
+        def run() -> List[Any]:
+            upstream = list(_exec_stream(plan))
 
-        return Dataset([_Source(gen, name="Repartition")])
+            @ray_tpu.remote
+            def _count(b: Block) -> int:
+                return block_num_rows(b)
+
+            counts = ray_tpu.get([_count.remote(r) for r in upstream])
+            total = sum(counts)
+            per = -(-total // N) if total else 1
+
+            @ray_tpu.remote
+            def _slices(block: Block, bounds: List[Tuple[int, int]]):
+                return tuple(block_slice(block, lo, hi)
+                             for lo, hi in bounds)
+
+            @ray_tpu.remote
+            def _merge(*parts: Block) -> Block:
+                nonempty = [p for p in parts if block_num_rows(p)]
+                return block_concat(nonempty) if nonempty else {}
+
+            out_parts: List[List[Any]] = [[] for _ in _range(N)]
+            offset = 0
+            for ref, cnt in zip(upstream, counts):
+                bounds = []
+                owners = []
+                pos = 0
+                while pos < cnt:
+                    out_idx = min((offset + pos) // per, N - 1)
+                    hi = min(cnt, (out_idx + 1) * per - offset)
+                    bounds.append((pos, hi))
+                    owners.append(out_idx)
+                    pos = hi
+                if not bounds:
+                    continue
+                if len(bounds) == 1:
+                    out_parts[owners[0]].append(ref)
+                else:
+                    parts = _slices.options(
+                        num_returns=len(bounds)).remote(ref, bounds)
+                    for own, part in zip(owners, parts):
+                        out_parts[own].append(part)
+                offset += cnt
+            return [_merge.remote(*parts) if parts else _merge.remote()
+                    for parts in out_parts]
+
+        return Dataset([_RefSource(run, name="Repartition")])
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        full = block_concat(list(self.iter_blocks()))
-        n = block_num_rows(full)
-        rng = np.random.default_rng(seed)
-        perm = rng.permutation(n)
-        shuffled = {k: v[perm] for k, v in full.items()}
-        nb = max(1, n // DEFAULT_BLOCK_ROWS)
-        per = -(-n // nb)
+        """Two-stage distributed shuffle: each block scatters its rows to
+        P random partitions; each reduce merges and locally permutes —
+        the composition is a uniform global shuffle with O(block) driver
+        memory."""
+        plan = list(self._plan)
 
-        def gen(shuffled=shuffled, n=n, per=per):
-            for i in _range(0, n, per):
-                yield block_slice(shuffled, i, min(i + per, n))
+        def run() -> List[Any]:
+            upstream = list(_exec_stream(plan))
+            P = len(upstream)
+            if P <= 1:
 
-        return Dataset([_Source(gen, name="RandomShuffle")])
+                @ray_tpu.remote
+                def _local_shuffle(b: Block, seed=seed) -> Block:
+                    n = block_num_rows(b)
+                    perm = np.random.default_rng(seed).permutation(n)
+                    return {k: np.asarray(v)[perm] for k, v in b.items()}
+
+                return [_local_shuffle.remote(r) for r in upstream]
+
+            @ray_tpu.remote
+            def _scatter(block: Block, block_seed: int, P=P):
+                rng = np.random.default_rng(block_seed)
+                codes = rng.integers(0, P, block_num_rows(block))
+                return tuple(
+                    {k: np.asarray(v)[codes == p]
+                     for k, v in block.items()}
+                    for p in _range(P))
+
+            @ray_tpu.remote
+            def _merge_permute(part_seed: int, *parts: Block) -> Block:
+                nonempty = [p for p in parts if block_num_rows(p)]
+                merged = block_concat(nonempty) if nonempty else {}
+                n = block_num_rows(merged)
+                perm = np.random.default_rng(part_seed).permutation(n)
+                return {k: np.asarray(v)[perm] for k, v in merged.items()}
+
+            root = np.random.default_rng(seed)
+            seeds = [int(s) for s in
+                     root.integers(0, 2**31 - 1, size=2 * P)]
+            rows = [_scatter.options(num_returns=P).remote(u, seeds[i])
+                    for i, u in enumerate(upstream)]
+            return [_merge_permute.remote(seeds[P + p],
+                                          *[row[p] for row in rows])
+                    for p in _range(P)]
+
+        return Dataset([_RefSource(run, name="RandomShuffle")])
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
-        """Global sort by a column (materializing — reference sort is a
-        distributed range shuffle; at this scale a gather sort wins)."""
-        full = block_concat(list(self.iter_blocks()))
-        order = np.argsort(np.asarray(full[key]), kind="stable")
-        if descending:
-            order = order[::-1]
-        data = {k: v[order] for k, v in full.items()}
-        n = block_num_rows(data)
-        per = max(1, min(DEFAULT_BLOCK_ROWS, n))
+        """Distributed range-partition sort: sample key quantiles (the
+        only data the driver touches), partition every block by the
+        boundaries, sort each range locally. Output blocks are globally
+        ordered."""
+        plan = list(self._plan)
 
-        def gen(data=data, n=n, per=per):
-            for i in _range(0, n, per):
-                yield block_slice(data, i, min(i + per, n))
+        def run() -> List[Any]:
+            upstream = list(_exec_stream(plan))
+            P = len(upstream)
 
-        return Dataset([_Source(gen, name="Sort")])
+            @ray_tpu.remote
+            def _sort_block(b: Block, key=key,
+                            descending=descending) -> Block:
+                order = np.argsort(np.asarray(b[key]), kind="stable")
+                if descending:
+                    order = order[::-1]
+                return {k: np.asarray(v)[order] for k, v in b.items()}
+
+            if P <= 1:
+                return [_sort_block.remote(r) for r in upstream]
+
+            @ray_tpu.remote
+            def _sample(b: Block, key=key, k: int = 64):
+                vals = np.sort(np.asarray(b[key]))
+                if len(vals) == 0:
+                    return vals
+                idx = np.linspace(0, len(vals) - 1,
+                                  min(k, len(vals))).astype(np.int64)
+                return vals[idx]
+
+            samples = [s for s in
+                       ray_tpu.get([_sample.remote(r) for r in upstream])
+                       if len(s)]
+            if not samples:
+                return list(upstream)
+            merged = np.sort(np.concatenate(samples))
+            # P-1 interior boundaries at the sample quantiles.
+            q = np.linspace(0, len(merged) - 1, P + 1)[1:-1]
+            bounds = merged[q.astype(np.int64)]
+
+            @ray_tpu.remote
+            def _range_part(block: Block, key=key, bounds=bounds, P=P):
+                codes = np.searchsorted(bounds, np.asarray(block[key]),
+                                        side="right")
+                return tuple(
+                    {k: np.asarray(v)[codes == p]
+                     for k, v in block.items()}
+                    for p in _range(P))
+
+            @ray_tpu.remote
+            def _sort_merge(key: str, descending: bool,
+                            *parts: Block) -> Block:
+                nonempty = [p for p in parts if block_num_rows(p)]
+                merged = block_concat(nonempty) if nonempty else {}
+                if not block_num_rows(merged):
+                    return merged
+                order = np.argsort(np.asarray(merged[key]), kind="stable")
+                if descending:
+                    order = order[::-1]
+                return {k: np.asarray(v)[order] for k, v in merged.items()}
+
+            rows = [_range_part.options(num_returns=P).remote(u)
+                    for u in upstream]
+            parts = [_sort_merge.remote(key, descending,
+                                        *[row[p] for row in rows])
+                     for p in _range(P)]
+            # Ascending ranges; descending output reverses the range order
+            # (each range is already internally descending).
+            return parts[::-1] if descending else parts
+
+        return Dataset([_RefSource(run, name="Sort")])
 
     def groupby(self, key: str, *,
                 num_partitions: Optional[int] = None) -> "GroupedData":

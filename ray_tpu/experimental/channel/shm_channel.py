@@ -3,20 +3,28 @@ python/ray/experimental/channel/shared_memory_channel.py:151 and the C++
 mutable-object plane, src/ray/core_worker/experimental_mutable_object_
 manager.cc).
 
-One writer, one reader, single-slot seqlock over an mmap'd /dev/shm file:
+Single-producer/single-consumer RING over an mmap'd /dev/shm file, so
+pipelined compiled-DAG executes keep multiple values in flight:
 
-    [ seq u64 | payload_len u64 | payload ... ]
+    [ wseq u64 | rseq u64 | closed u64 | nslots u64 | slot_size u64 |
+      slots: nslots x (len u64 | payload) ]
 
-The writer bumps seq to ODD while mutating, EVEN when the payload is
-complete; the reader waits for a NEW even seq and re-checks seq after
-copying (torn reads retry). Synchronization is adaptive polling — a short
-spin for the latency case, escalating sleeps for the idle case — because
-the consumers are pinned per-actor loops that read immediately in steady
-state. No RPCs, no object-plane bookkeeping: this is the data plane for
-compiled DAG edges where both endpoints are known ahead of time.
+Writer: waits while wseq - rseq == nslots (ring full), writes slot
+wseq % nslots, then publishes by bumping wseq. Reader: waits while
+rseq == wseq, reads slot rseq % nslots, then acknowledges by bumping
+rseq. The counters are the only synchronization — x86-TSO (and the
+aarch64 equivalent through CPython's memory handling) keeps the payload
+stores ordered before the counter store. No torn reads: a slot cannot be
+rewritten until the reader acks it.
+
+Synchronization is adaptive polling — short spin for the latency case,
+escalating sleeps for the idle case — because consumers are pinned
+per-actor loops that read immediately in steady state. No RPCs and no
+object-plane bookkeeping: this is the data plane for compiled-DAG edges
+where both endpoints are known ahead of time.
 
 Values serialize with pickle-5 (out-of-band buffers flattened into the
-slot) — numpy payloads are one memcpy each way. Values larger than the
+slot) — numpy payloads are one memcpy each way. Values larger than one
 slot raise; compiled DAGs fall back to the object plane for those.
 """
 
@@ -29,8 +37,8 @@ import struct
 import time
 from typing import Any, Optional
 
-_HDR = struct.Struct("<QQ")  # seq, payload_len
-CLOSED_LEN = (1 << 64) - 1  # sentinel payload_len: channel closed
+_HDR = struct.Struct("<QQQQQ")  # wseq, rseq, closed, nslots, slot_size
+_LEN = struct.Struct("<Q")
 
 
 class ChannelClosed(Exception):
@@ -41,81 +49,97 @@ class ShmChannel:
     """create=True allocates the backing file; both ends then open by path."""
 
     def __init__(self, path: str, capacity: int = 1 << 20,
-                 create: bool = False):
+                 create: bool = False, slots: int = 8):
         self.path = path
         if create:
+            size = _HDR.size + slots * (_LEN.size + capacity)
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
             try:
-                os.ftruncate(fd, _HDR.size + capacity)
+                os.ftruncate(fd, size)
             finally:
                 os.close(fd)
-        size = os.path.getsize(path)
-        self.capacity = size - _HDR.size
+        file_size = os.path.getsize(path)
         self._f = open(path, "r+b")
-        self._mm = mmap.mmap(self._f.fileno(), size)
+        self._mm = mmap.mmap(self._f.fileno(), file_size)
         if create:
-            self._mm[:_HDR.size] = _HDR.pack(0, 0)
-        self._last_read_seq = 0
+            _HDR.pack_into(self._mm, 0, 0, 0, 0, slots, capacity)
+        _, _, _, self.nslots, self.capacity = _HDR.unpack_from(self._mm, 0)
+
+    # -- header helpers --------------------------------------------------
+    def _hdr(self):
+        return _HDR.unpack_from(self._mm, 0)
+
+    def _slot_off(self, seq: int) -> int:
+        return _HDR.size + (seq % self.nslots) * (_LEN.size + self.capacity)
+
+    @staticmethod
+    def _wait(spins: int, deadline: Optional[float], what: str) -> int:
+        spins += 1
+        if spins >= 200:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(f"channel {what} timed out")
+            # Idle: sleep, growing to 200µs — keeps an idle pinned loop
+            # near-free on a shared core while staying sub-ms reactive.
+            time.sleep(min(2e-4, 1e-5 * (spins - 199)))
+        return spins
 
     # -- writer ----------------------------------------------------------
-    def write(self, value: Any) -> None:
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
         buffers = []
         body = pickle.dumps(value, protocol=5,
                             buffer_callback=buffers.append)
-        parts = [struct.pack("<I", len(body)), body]
+        parts = [struct.pack("<I", len(buffers)),
+                 struct.pack("<I", len(body)), body]
         for b in buffers:
             raw = b.raw()
             parts.append(struct.pack("<Q", raw.nbytes))
-            parts.append(raw)
-        payload = b"".join(p if isinstance(p, bytes) else bytes(p)
-                           for p in parts)
-        n_buf = struct.pack("<I", len(buffers))
-        total = len(n_buf) + len(payload)
-        if total > self.capacity:
+            parts.append(raw if isinstance(raw, bytes) else bytes(raw))
+        payload = b"".join(parts)
+        if len(payload) > self.capacity:
             raise ValueError(
-                f"value needs {total} bytes; channel slot is "
+                f"value needs {len(payload)} bytes; channel slot is "
                 f"{self.capacity}")
         mm = self._mm
-        seq, _ = _HDR.unpack_from(mm, 0)
-        _HDR.pack_into(mm, 0, seq + 1, 0)  # odd: write in progress
-        mm[_HDR.size:_HDR.size + len(n_buf)] = n_buf
-        mm[_HDR.size + len(n_buf):_HDR.size + total] = payload
-        _HDR.pack_into(mm, 0, seq + 2, total)  # even: complete
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spins = 0
+        while True:
+            wseq, rseq, closed, _, _ = self._hdr()
+            if closed:
+                raise ChannelClosed(self.path)
+            if wseq - rseq < self.nslots:
+                break
+            spins = self._wait(spins, deadline, "write")
+        off = self._slot_off(wseq)
+        _LEN.pack_into(mm, off, len(payload))
+        mm[off + _LEN.size:off + _LEN.size + len(payload)] = payload
+        struct.pack_into("<Q", mm, 0, wseq + 1)  # publish
 
     def close(self) -> None:
-        """Writer side: mark closed (readers raise ChannelClosed)."""
+        """Mark closed: blocked/later readers raise ChannelClosed (any
+        values already in the ring remain readable first)."""
         try:
-            mm = self._mm
-            seq, _ = _HDR.unpack_from(mm, 0)
-            _HDR.pack_into(mm, 0, seq + (2 if seq % 2 == 0 else 1),
-                           CLOSED_LEN)
+            struct.pack_into("<Q", self._mm, 16, 1)
         except (ValueError, OSError):
             pass  # already unmapped
 
     # -- reader ----------------------------------------------------------
     def read(self, timeout: Optional[float] = None) -> Any:
-        """Block until a value NEWER than the last read arrives."""
+        """Pop the next value in FIFO order."""
         mm = self._mm
         deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
         while True:
-            seq, plen = _HDR.unpack_from(mm, 0)
-            if plen == CLOSED_LEN:
+            wseq, rseq, closed, _, _ = self._hdr()
+            if rseq < wseq:
+                break
+            if closed:
                 raise ChannelClosed(self.path)
-            if seq % 2 == 0 and seq > self._last_read_seq and plen:
-                data = bytes(mm[_HDR.size:_HDR.size + plen])
-                seq2, _ = _HDR.unpack_from(mm, 0)
-                if seq2 == seq:  # no tear
-                    self._last_read_seq = seq
-                    return self._decode(data)
-            spins += 1
-            if spins < 200:
-                continue  # burst latency: pure spin (~tens of µs)
-            if deadline is not None and time.monotonic() > deadline:
-                raise TimeoutError(f"channel read timed out: {self.path}")
-            # Idle: sleep, growing to 200µs — keeps an idle pinned loop
-            # near-free on a shared core while staying sub-ms reactive.
-            time.sleep(min(2e-4, 1e-5 * (spins - 199)))
+            spins = self._wait(spins, deadline, "read")
+        off = self._slot_off(rseq)
+        (plen,) = _LEN.unpack_from(mm, off)
+        data = bytes(mm[off + _LEN.size:off + _LEN.size + plen])
+        struct.pack_into("<Q", mm, 8, rseq + 1)  # ack: slot reusable
+        return self._decode(data)
 
     @staticmethod
     def _decode(data: bytes) -> Any:

@@ -13,6 +13,7 @@ import json
 import os
 import subprocess
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional
 
@@ -150,6 +151,20 @@ class JobSubmissionClient:
         except Exception:
             raw = self._kv_get(f"job_logs:{submission_id}")
             return bytes(raw or b"").decode(errors="replace")
+
+    def wait_until_finished(self, submission_id: str,
+                            timeout: float = 600.0,
+                            poll_s: float = 0.5) -> str:
+        """Block until the job reaches a terminal status; returns it."""
+        deadline = time.monotonic() + timeout
+        while True:
+            status = self.get_job_status(submission_id)
+            if status in (SUCCEEDED, FAILED, STOPPED):
+                return status
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"job {submission_id} still {status} after {timeout}s")
+            time.sleep(poll_s)
 
     def stop_job(self, submission_id: str) -> bool:
         sup = ray_tpu.get_actor(f"_job_supervisor:{submission_id}")

@@ -1,29 +1,193 @@
-"""CLI (reference: python/ray/scripts/scripts.py — `ray status/list/...`).
+"""CLI (reference: python/ray/scripts/scripts.py — `ray start/stop/status/
+submit/...`, registrations at scripts.py:2665-2725).
 
-Usage: python -m ray_tpu.scripts.cli --address HOST:PORT <command>
-Commands: status | nodes | actors | workers | jobs | placement-groups
+Cluster lifecycle:
+    python -m ray_tpu.scripts.cli start --head [--port P] [--resources J]
+    python -m ray_tpu.scripts.cli start --address HOST:PORT
+    python -m ray_tpu.scripts.cli stop
+    python -m ray_tpu.scripts.cli submit --address HOST:PORT script.py ...
+    python -m ray_tpu.scripts.cli serve-deploy config.yaml --address ...
+    python -m ray_tpu.scripts.cli cluster-up cluster.yaml
+
+State queries (need --address):
+    status | nodes | actors | workers | jobs | placement-groups | tasks |
+    timeline | memory | metrics
+
+`start` records the running cluster in /tmp/ray_tpu/current_cluster.json
+(reference: /tmp/ray/ray_current_cluster) so `stop` and address-less
+commands can find it.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
 import sys
+import time
+
+CLUSTER_FILE = "/tmp/ray_tpu/current_cluster.json"
 
 
-def main() -> None:
-    parser = argparse.ArgumentParser(prog="ray_tpu")
-    parser.add_argument("--address", required=True,
-                        help="GCS address host:port of a running cluster")
-    parser.add_argument("command", choices=[
-        "status", "nodes", "actors", "workers", "jobs", "placement-groups",
-        "tasks", "timeline", "memory", "metrics"])
-    args = parser.parse_args()
+def _write_cluster_file(entry: dict) -> None:
+    os.makedirs(os.path.dirname(CLUSTER_FILE), exist_ok=True)
+    entries = _read_cluster_file()
+    entries.append(entry)
+    with open(CLUSTER_FILE, "w") as f:
+        json.dump(entries, f)
 
+
+def _read_cluster_file() -> list:
+    try:
+        with open(CLUSTER_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return []
+
+
+def _resolve_address(args) -> str:
+    if getattr(args, "address", None):
+        return args.address
+    for entry in reversed(_read_cluster_file()):
+        if entry.get("head"):
+            return "{}:{}".format(*entry["gcs_address"])
+    sys.exit("no --address given and no recorded cluster "
+             f"(start one with `start --head`; state file {CLUSTER_FILE})")
+
+
+def cmd_start(args) -> None:
+    from ray_tpu._private.node import Node
+
+    resources = json.loads(args.resources) if args.resources else None
+    if args.head:
+        node = Node(head=True, resources=resources,
+                    object_store_memory=args.object_store_memory or None,
+                    session_dir=args.session_dir or None)
+        role = "head"
+    else:
+        if not args.address:
+            sys.exit("start: joining a cluster requires --address HOST:PORT")
+        host, _, port = args.address.rpartition(":")
+        node = Node(head=False, gcs_address=(host, int(port)),
+                    resources=resources,
+                    object_store_memory=args.object_store_memory or None,
+                    session_dir=args.session_dir or None,
+                    node_name=args.node_name)
+        role = "worker"
+    pids = [p.pid for p in node.processes]
+    _write_cluster_file({
+        "head": args.head, "gcs_address": list(node.gcs_address),
+        "session_dir": node.session_dir, "pids": pids,
+        "started_at": time.time(),
+    })
+    print(json.dumps({
+        "role": role,
+        "gcs_address": f"{node.gcs_address[0]}:{node.gcs_address[1]}",
+        "session_dir": node.session_dir,
+        "pids": pids,
+    }, indent=2))
+    if args.block:
+        print("-- blocking; Ctrl-C or `stop` to shut down --",
+              file=sys.stderr, flush=True)
+        try:
+            while all(p.poll() is None for p in node.processes):
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+        node.shutdown()
+    else:
+        # Detach: the daemon processes survive this CLI process; disarm
+        # the atexit/signal shutdown hooks that would reap them.
+        import atexit
+
+        atexit.unregister(node.shutdown)
+        from ray_tpu._private import node as node_mod
+
+        if node in node_mod._signal_nodes:
+            node_mod._signal_nodes.remove(node)
+
+
+def cmd_stop(_args) -> None:
+    entries = _read_cluster_file()
+    if not entries:
+        print("no recorded cluster")
+        return
+    stopped = 0
+    for entry in entries:
+        for pid in entry.get("pids", []):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                stopped += 1
+            except ProcessLookupError:
+                pass
+    try:
+        os.unlink(CLUSTER_FILE)
+    except OSError:
+        pass
+    print(f"sent SIGTERM to {stopped} processes")
+
+
+def cmd_submit(args) -> None:
+    import ray_tpu
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    ray_tpu.init(address=_resolve_address(args))
+    try:
+        client = JobSubmissionClient()
+        import shlex
+
+        # The supervisor runs entrypoints with shell=True: quote so paths
+        # with spaces survive and metacharacters aren't interpreted.
+        entrypoint = shlex.join(
+            [sys.executable, args.script] + (args.script_args or []))
+        sub_id = client.submit_job(entrypoint=entrypoint)
+        print(f"submitted job {sub_id}")
+        if args.wait:
+            status = client.wait_until_finished(sub_id, timeout=args.timeout)
+            print(f"job {sub_id}: {status}")
+            logs = client.get_job_logs(sub_id)
+            if logs:
+                sys.stdout.write(logs)
+            if status != "SUCCEEDED":
+                sys.exit(1)
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_serve_deploy(args) -> None:
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+    try:
+        from ray_tpu.serve.schema import deploy_config
+
+        out = deploy_config(args.config)
+        print(json.dumps(out, indent=2))
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_cluster_up(args) -> None:
+    """Start an autoscaler from a cluster YAML (reference: `ray up`)."""
+    from ray_tpu.autoscaler import autoscaler_from_yaml
+
+    ctl = autoscaler_from_yaml(args.config)
+    print(json.dumps({"status": "autoscaler running",
+                      "config": args.config}, indent=2))
+    try:
+        while True:
+            time.sleep(5)
+            print(json.dumps(ctl.summary(), default=str), flush=True)
+    except KeyboardInterrupt:
+        ctl.stop()
+
+
+def _state_command(args) -> None:
     import ray_tpu
     from ray_tpu.util import state
 
-    ray_tpu.init(address=args.address)
+    ray_tpu.init(address=_resolve_address(args))
     try:
         if args.command == "status":
             out = state.cluster_summary()
@@ -51,6 +215,60 @@ def main() -> None:
         print()
     finally:
         ray_tpu.shutdown()
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    # Legacy order (`--address X status`) stays valid: a top-level
+    # --address is accepted before the subcommand.
+    parser.add_argument("--address", dest="global_address", default=None)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", help="GCS host:port to join (worker mode)")
+    p.add_argument("--resources", help="JSON resource dict override")
+    p.add_argument("--object-store-memory", type=int, default=0)
+    p.add_argument("--session-dir", default="")
+    p.add_argument("--node-name", default="")
+    p.add_argument("--block", action="store_true",
+                   help="stay attached; Ctrl-C stops the node")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop recorded cluster processes")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("submit", help="submit a script as a job")
+    p.add_argument("--address")
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("script")
+    p.add_argument("script_args", nargs="*")
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("serve-deploy",
+                       help="deploy serve applications from a YAML config")
+    p.add_argument("config")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_serve_deploy)
+
+    p = sub.add_parser("cluster-up",
+                       help="run an autoscaler from a cluster YAML")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_cluster_up)
+
+    for name in ("status", "nodes", "actors", "workers", "jobs",
+                 "placement-groups", "tasks", "timeline", "memory",
+                 "metrics"):
+        p = sub.add_parser(name)
+        p.add_argument("--address")
+        p.set_defaults(fn=_state_command)
+
+    args = parser.parse_args()
+    if getattr(args, "global_address", None) and not getattr(
+            args, "address", None):
+        args.address = args.global_address
+    args.fn(args)
 
 
 def _jsonable(o):

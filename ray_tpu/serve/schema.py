@@ -1,0 +1,161 @@
+"""Declarative Serve config: validate + deploy from a YAML/dict spec
+(reference: python/ray/serve/schema.py — ServeDeploySchema /
+ServeApplicationSchema pydantic models — and serve/scripts.py
+`serve deploy`).
+
+Zero-dependency validation (dataclasses, explicit checks) instead of
+pydantic. Shape:
+
+    applications:
+      - name: llm
+        route_prefix: /v1
+        import_path: my_pkg.apps:build_app      # module:attr
+        args: {model: tiny}                     # builder kwargs
+        deployments:                            # optional overrides
+          - name: "OpenAI:tiny"
+            num_replicas: 2
+            max_ongoing_requests: 16
+
+`import_path` resolves to either an Application (used as-is) or a callable
+builder (called with `args`)."""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.serve import Application, run
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclasses.dataclass
+class DeploymentOverride:
+    name: str
+    num_replicas: Optional[int] = None
+    max_ongoing_requests: Optional[int] = None
+    user_config: Optional[Dict[str, Any]] = None
+    ray_actor_options: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "DeploymentOverride":
+        if "name" not in raw:
+            raise ValueError("deployment override requires 'name'")
+        unknown = set(raw) - {f.name for f in dataclasses.fields(cls)}
+        if unknown:
+            raise ValueError(f"unknown deployment fields: {sorted(unknown)}")
+        return cls(**raw)
+
+
+@dataclasses.dataclass
+class ApplicationSchema:
+    name: str
+    import_path: str
+    route_prefix: str = "/"
+    args: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    deployments: List[DeploymentOverride] = dataclasses.field(
+        default_factory=list)
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "ApplicationSchema":
+        for req in ("name", "import_path"):
+            if req not in raw:
+                raise ValueError(f"application requires {req!r}")
+        if ":" not in raw["import_path"]:
+            raise ValueError(
+                "import_path must be 'module.sub:attribute'")
+        deps = [DeploymentOverride.parse(d)
+                for d in raw.get("deployments", [])]
+        unknown = set(raw) - {"name", "import_path", "route_prefix",
+                              "args", "deployments"}
+        if unknown:
+            raise ValueError(f"unknown application fields: {sorted(unknown)}")
+        return cls(name=raw["name"], import_path=raw["import_path"],
+                   route_prefix=raw.get("route_prefix", "/"),
+                   args=dict(raw.get("args") or {}), deployments=deps)
+
+    def build(self) -> Application:
+        mod_name, _, attr = self.import_path.partition(":")
+        mod = importlib.import_module(mod_name)
+        target = getattr(mod, attr)
+        if isinstance(target, Application):
+            app = target
+        elif callable(target):
+            app = target(**self.args)
+        else:
+            raise TypeError(
+                f"{self.import_path} is neither an Application nor callable")
+        if not isinstance(app, Application):
+            raise TypeError(
+                f"{self.import_path} did not produce an Application")
+        for ov in self.deployments:
+            self._apply_override(app, ov)
+        return app
+
+    def _apply_override(self, app: Application,
+                        ov: DeploymentOverride) -> None:
+        found = False
+        stack = [app]
+        while stack:
+            node = stack.pop()
+            dep = node.deployment
+            if dep.name == ov.name:
+                found = True
+                if ov.num_replicas is not None:
+                    dep.num_replicas = ov.num_replicas
+                if ov.max_ongoing_requests is not None:
+                    dep.max_ongoing_requests = ov.max_ongoing_requests
+                if ov.user_config is not None:
+                    dep.user_config = ov.user_config
+                if ov.ray_actor_options is not None:
+                    dep.ray_actor_options = ov.ray_actor_options
+            for a in list(node.args) + list(node.kwargs.values()):
+                if isinstance(a, Application):
+                    stack.append(a)
+        if not found:
+            raise ValueError(
+                f"override references unknown deployment {ov.name!r}")
+
+
+@dataclasses.dataclass
+class DeploySchema:
+    applications: List[ApplicationSchema]
+
+    @classmethod
+    def parse(cls, raw: Dict[str, Any]) -> "DeploySchema":
+        apps = raw.get("applications")
+        if not isinstance(apps, list) or not apps:
+            raise ValueError("config requires a non-empty 'applications' list")
+        parsed = [ApplicationSchema.parse(a) for a in apps]
+        names = [a.name for a in parsed]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate application names: {names}")
+        return cls(applications=parsed)
+
+
+def load_config(path_or_dict: Any) -> DeploySchema:
+    if isinstance(path_or_dict, dict):
+        return DeploySchema.parse(path_or_dict)
+    import yaml
+
+    with open(path_or_dict) as f:
+        return DeploySchema.parse(yaml.safe_load(f))
+
+
+def deploy_config(path_or_dict: Any) -> Dict[str, Any]:
+    """Validate + deploy every application in the config (reference:
+    `serve deploy` REST/CLI flow, serve/scripts.py). Returns a summary."""
+    schema = load_config(path_or_dict)
+    deployed = []
+    for app_schema in schema.applications:
+        app = app_schema.build()
+        run(app, name=app_schema.name,
+            route_prefix=app_schema.route_prefix)
+        deployed.append({"name": app_schema.name,
+                         "route_prefix": app_schema.route_prefix,
+                         "deployment": app.deployment.name})
+        logger.info("deployed application %s at %s", app_schema.name,
+                    app_schema.route_prefix)
+    return {"applications": deployed}

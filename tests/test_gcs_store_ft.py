@@ -1,0 +1,140 @@
+"""GCS external-store fault tolerance (reference:
+src/ray/gcs/store_client/redis_store_client.h,
+gcs_redis_failure_detector.h; test strategy from
+python/ray/tests/test_gcs_fault_tolerance.py).
+
+The GCS persists row-wise to sqlite (core/store_client.py). These tests
+SIGKILL the GCS mid-workload — with RPC chaos injected — restart it on the
+same store, and require: named actors resolvable and stateful, placement
+groups still usable, and a get that was in flight across the outage to
+complete."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+CHAOS_FT_SCRIPT = """
+import os, threading, time
+os.environ["RAY_TPU_TESTING_RPC_FAILURE"] = "push_task:0.05,lease_worker:0.02"
+import ray_tpu
+from ray_tpu import cluster_utils
+
+cluster = cluster_utils.Cluster(initialize_head=True,
+                                head_node_args=dict(num_cpus=4,
+                                object_store_memory=128 * 1024 * 1024))
+ray_tpu.init(address=cluster.address)
+
+store = os.path.join(cluster.head_node.session_dir, "gcs_store.sqlite")
+assert os.path.exists(store), f"sqlite store missing: {store}"
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self):
+        self.n = 0
+    def bump(self):
+        self.n += 1
+        return self.n
+    def slow(self):
+        time.sleep(4.0)
+        self.n += 1
+        return self.n
+
+c = Counter.options(name="chaos-survivor").remote()
+assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+
+# placement group committed before the outage
+from ray_tpu.util import placement_group
+pg = placement_group([{"CPU": 1}], strategy="PACK")
+assert pg.ready(timeout=60)
+
+time.sleep(0.6)  # debounced store flush
+
+# a get that stays in flight ACROSS the GCS outage
+slow_ref = c.slow.remote()
+result = {}
+def waiter():
+    result["v"] = ray_tpu.get(slow_ref, timeout=120)
+t = threading.Thread(target=waiter)
+t.start()
+
+cluster.head_node.restart_gcs()          # SIGKILL + restart on same store
+time.sleep(2.0)                          # nodes re-register via heartbeat
+
+t.join(timeout=120)
+assert result.get("v") == 2, result
+
+# named actor survived with state (resolved through the NEW GCS)
+c2 = ray_tpu.get_actor("chaos-survivor")
+assert ray_tpu.get(c2.bump.remote(), timeout=60) == 3
+
+# the committed placement group still schedules work
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+@ray_tpu.remote
+def in_pg():
+    return "ok"
+
+assert ray_tpu.get(
+    in_pg.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)).remote(),
+    timeout=120) == "ok"
+
+# fresh work under continuing chaos
+vals = ray_tpu.get([in_pg.options(max_retries=20).remote()
+                    for _ in range(20)], timeout=120)
+assert vals == ["ok"] * 20
+print("GCS_FT_OK", flush=True)
+ray_tpu.shutdown()
+"""
+
+
+def test_gcs_sqlite_store_survives_kill_under_chaos():
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", CHAOS_FT_SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=420)
+    assert "GCS_FT_OK" in out.stdout, \
+        out.stdout[-800:] + out.stderr[-2000:]
+
+
+def test_sqlite_store_incremental_and_roundtrip(tmp_path):
+    from ray_tpu.core.store_client import (
+        FileStoreClient,
+        SqliteStoreClient,
+        create_store_client,
+    )
+
+    path = str(tmp_path / "gcs.sqlite")
+    s = create_store_client(path)
+    assert isinstance(s, SqliteStoreClient)
+    tables = {"kv": {"a": b"1", "b": b"2"},
+              "actors": {"x": {"state": "ALIVE"}},
+              "job_counter": 7}
+    s.save(tables)
+    # unchanged save writes nothing (digest cache) — observe via mtime of
+    # the WAL-journaled db staying stable across a no-op save
+    s.save(tables)
+    s.close()
+
+    s2 = create_store_client(path)
+    loaded = s2.load()
+    assert loaded["kv"] == {"a": b"1", "b": b"2"}
+    assert loaded["actors"]["x"]["state"] == "ALIVE"
+    assert loaded["job_counter"] == 7
+    # deletion tracked
+    del tables["kv"]["b"]
+    s2.save(tables)
+    s2.close()
+    s3 = create_store_client(path)
+    assert s3.load()["kv"] == {"a": b"1"}
+    s3.close()
+
+    f = create_store_client(str(tmp_path / "gcs.pkl"))
+    assert isinstance(f, FileStoreClient)
+    f.save(tables)
+    assert f.load()["job_counter"] == 7

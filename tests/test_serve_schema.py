@@ -1,0 +1,98 @@
+"""Declarative serve config deploy (reference: python/ray/serve/schema.py
+ServeDeploySchema + serve/scripts.py `serve deploy`)."""
+
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.schema import DeploySchema, deploy_config, load_config
+
+
+@pytest.fixture
+def serve_instance(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+@pytest.fixture
+def app_module(tmp_path):
+    mod = tmp_path / "schema_test_app.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu import serve
+
+        @serve.deployment(name="Echo")
+        class Echo:
+            def __init__(self, prefix="echo"):
+                self.prefix = prefix
+
+            def __call__(self, request):
+                body = request.get("body") or {}
+                return {"out": f"{self.prefix}:{body.get('msg', '')}"}
+
+        def build_app(prefix="echo"):
+            return Echo.bind(prefix)
+
+        prebuilt = Echo.bind("prebuilt")
+    """))
+    sys.path.insert(0, str(tmp_path))
+    yield "schema_test_app"
+    sys.path.remove(str(tmp_path))
+
+
+def test_schema_validation_errors():
+    with pytest.raises(ValueError, match="applications"):
+        DeploySchema.parse({})
+    with pytest.raises(ValueError, match="import_path"):
+        DeploySchema.parse({"applications": [{"name": "a"}]})
+    with pytest.raises(ValueError, match="module.sub:attribute"):
+        DeploySchema.parse({"applications": [
+            {"name": "a", "import_path": "no_colon"}]})
+    with pytest.raises(ValueError, match="duplicate"):
+        DeploySchema.parse({"applications": [
+            {"name": "a", "import_path": "m:x"},
+            {"name": "a", "import_path": "m:y"}]})
+    with pytest.raises(ValueError, match="unknown application fields"):
+        DeploySchema.parse({"applications": [
+            {"name": "a", "import_path": "m:x", "bogus": 1}]})
+
+
+def test_deploy_from_dict_builder(serve_instance, app_module):
+    out = deploy_config({"applications": [{
+        "name": "echo-app",
+        "import_path": f"{app_module}:build_app",
+        "route_prefix": "/echo",
+        "args": {"prefix": "cfg"},
+        "deployments": [{"name": "Echo", "num_replicas": 1}],
+    }]})
+    assert out["applications"][0]["route_prefix"] == "/echo"
+    handle = serve.get_deployment_handle("Echo")
+    resp = handle.remote({"body": {"msg": "hi"}}).result(timeout=60)
+    assert resp == {"out": "cfg:hi"}
+
+
+def test_deploy_from_yaml_prebuilt(serve_instance, app_module, tmp_path):
+    cfg = tmp_path / "serve.yaml"
+    cfg.write_text(textwrap.dedent(f"""
+        applications:
+          - name: pre
+            import_path: {app_module}:prebuilt
+            route_prefix: /pre
+    """))
+    schema = load_config(str(cfg))
+    assert schema.applications[0].name == "pre"
+    deploy_config(str(cfg))
+    handle = serve.get_deployment_handle("Echo")
+    resp = handle.remote({"body": {"msg": "x"}}).result(timeout=60)
+    assert resp == {"out": "prebuilt:x"}
+
+
+def test_override_unknown_deployment_rejected(serve_instance, app_module):
+    with pytest.raises(ValueError, match="unknown deployment"):
+        deploy_config({"applications": [{
+            "name": "bad",
+            "import_path": f"{app_module}:build_app",
+            "deployments": [{"name": "Nope", "num_replicas": 2}],
+        }]})
