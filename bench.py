@@ -11,6 +11,7 @@ stderr so the stdout contract stays one line.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -84,11 +85,29 @@ def bench_tpu_model():
             llama_train_bench,
             llm_serving_bench,
         )
+        from ray_tpu.benchmarks.model_bench import (
+            llama_train_large_bench,
+            llm_serving_8b_int8_bench,
+            llm_serving_large_bench,
+        )
 
         flash = flash_attention_bench()
         llama = llama_train_bench()
         serving = llm_serving_bench()
-        return {"flash": flash, "llama": llama, "serving": serving}
+        out = {"flash": flash, "llama": llama, "serving": serving}
+        # BASELINE-scale benches (config 2 / config 4 at their named sizes).
+        # Each is independently best-effort: a compile/HBM regression in one
+        # must not hide the others' numbers.
+        if not os.environ.get("RAY_TPU_BENCH_SKIP_LARGE"):
+            for name, fn in (("llama_large", llama_train_large_bench),
+                             ("serving_large", llm_serving_large_bench),
+                             ("serving_8b_int8", llm_serving_8b_int8_bench)):
+                try:
+                    out[name] = fn()
+                except Exception as e:  # noqa: BLE001
+                    print(f"{name} bench failed: {type(e).__name__}: {e}",
+                          file=sys.stderr)
+        return out
     except Exception as e:  # never block the control-plane bench
         print(f"tpu model bench skipped: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -118,6 +137,28 @@ def main():
             f"batching)",
             file=sys.stderr,
         )
+        if "llama_large" in tpu:
+            m = tpu["llama_large"]
+            print(
+                f"llama_2p4b_train_tokens_per_s: {m['tokens_per_s']:.0f} "
+                f"(MFU {m['mfu']*100:.1f}%, {m['params']/1e9:.2f}B params, "
+                f"bf16 + remat + adafactor, step {m['step_ms']:.0f} ms)",
+                file=sys.stderr)
+        if "serving_large" in tpu:
+            s = tpu["serving_large"]
+            print(
+                f"llm_serving_1b_decode_tokens_per_s: "
+                f"{s['tokens_per_s']:.0f} ({s['params']/1e9:.2f}B bf16, "
+                f"batch {s['batch']}, TTFT {s['ttft_s']*1e3:.0f} ms)",
+                file=sys.stderr)
+        if "serving_8b_int8" in tpu:
+            s = tpu["serving_8b_int8"]
+            print(
+                f"llm_serving_8b_int8_decode_tokens_per_s: "
+                f"{s['tokens_per_s']:.0f} ({s['params']/1e9:.2f}B params "
+                f"as {s['weight_bytes']/2**30:.1f} GiB int8, batch "
+                f"{s['batch']}, TTFT {s['ttft_s']*1e3:.0f} ms)",
+                file=sys.stderr)
 
     ray_tpu.init(object_store_memory=2 * 1024 * 1024 * 1024)
     try:

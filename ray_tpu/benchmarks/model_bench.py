@@ -178,6 +178,149 @@ def llm_serving_bench(*, batch: int = 8, prompt_len: int = 128,
     }
 
 
+def llama_train_large_bench(
+    *, batch: int = 4, seq: int = 2048, iters: int = 5,
+) -> Dict[str, Any]:
+    """BASELINE config 2 at real scale: the largest Llama that TRAINS on
+    one v5e (16 GiB HBM).
+
+    What fits and why (measured on chip): 2.37B params in bf16 with
+    gradient rematerialization + adafactor (factored second moments —
+    adam's fp32 m/v alone would be 8 bytes/param ≈ 19 GiB). Params 4.7 GiB
+    + grads 4.7 GiB + factored optimizer state (~MBs) + remat'd
+    activations ≈ 12 GiB. 3.2B initializes but its train step spills and
+    thrashes (8.8% MFU at batch 2); 8B bf16 params alone are 16 GiB — the
+    single-chip path toward 8B is int8 (serving, below) or multi-chip
+    FSDP (parallel/, exercised by dryrun_multichip)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel, count_params
+    from ray_tpu.train.step import init_train_state, make_train_step
+
+    cfg = LlamaConfig(
+        vocab_size=32_768, hidden_size=2560, intermediate_size=6912,
+        num_layers=32, num_heads=20, num_kv_heads=4, head_dim=128,
+        max_seq_len=seq, dtype=jnp.bfloat16, attention_impl="flash",
+        remat=True)
+    model = LlamaModel(cfg)
+    opt = optax.adafactor(3e-4)
+    ids = jnp.zeros((batch, seq), jnp.int32)
+    state = init_train_state(model, opt, ids)
+    n_params = count_params(state.params)
+    step = make_train_step(model, opt)
+    state, loss = step(state, ids, ids)
+    float(loss)  # warm: compile + one step
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, ids, ids)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    tokens = batch * seq
+    attn_flops = (6 * cfg.num_layers * batch * cfg.num_heads * seq * seq
+                  * cfg.head_dim * 0.5)
+    mfu = (6 * n_params * tokens + attn_flops) / dt / _peak_flops()
+    return {"params": n_params, "step_ms": dt * 1e3,
+            "tokens_per_s": tokens / dt, "mfu": mfu}
+
+
+def _serving_wave(eng, *, batch: int, prompt_len: int, max_tokens: int,
+                  vocab_hi: int = 30_000, seed: int = 0):
+    """One continuous-batching wave: admit `batch` prompts, run to
+    completion. Returns (tokens, wall_s, ttft_s). Shared by every serving
+    bench so TTFT/token accounting can only be fixed in one place."""
+    import numpy as np
+
+    from ray_tpu.llm._internal.engine import Request
+
+    rng = np.random.default_rng(seed)
+    t0 = time.perf_counter()
+    ttft = None
+    n = 0
+    for i in range(batch):
+        eng.add_request(Request(
+            f"r{i}", list(rng.integers(1, vocab_hi, prompt_len)),
+            max_tokens=max_tokens))
+    while eng.has_work():
+        outs = eng.step()
+        if outs and ttft is None:
+            ttft = time.perf_counter() - t0
+        n += len(outs)
+    return n, time.perf_counter() - t0, ttft
+
+
+def llm_serving_large_bench(*, batch: int = 8, prompt_len: int = 128,
+                            max_tokens: int = 48) -> Dict[str, Any]:
+    """BASELINE config 4 toward scale: a 1B+ bf16 model through the full
+    engine (paged KV + Pallas decode + continuous batching)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm._internal.engine import EngineConfig, LLMEngine, Request
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel, count_params
+
+    cfg = LlamaConfig(
+        vocab_size=32_768, hidden_size=2048, intermediate_size=5632,
+        num_layers=24, num_heads=16, num_kv_heads=8, head_dim=128,
+        max_seq_len=1024, dtype=jnp.bfloat16, attention_impl="flash",
+        remat=False)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    eng = LLMEngine(model, params, EngineConfig(
+        max_seqs=batch, page_size=64, max_pages_per_seq=16,
+        decode_steps=8))
+    _serving_wave(eng, batch=batch, prompt_len=prompt_len,
+                  max_tokens=8)  # warm
+    n, dt, ttft = _serving_wave(eng, batch=batch, prompt_len=prompt_len,
+                                max_tokens=max_tokens)
+    return {"params": count_params(params), "tokens_per_s": n / dt,
+            "ttft_s": ttft, "batch": batch}
+
+
+def llm_serving_8b_int8_bench(*, batch: int = 8, prompt_len: int = 128,
+                              max_tokens: int = 48) -> Dict[str, Any]:
+    """BASELINE config 4 at its NAMED scale: Llama-3-8B shape (8.03B
+    params incl. the 128k vocab) served from ONE v5e via int8 weights
+    (models/quant.py — bf16 8B weights alone exceed the 16 GiB HBM).
+    Dequant runs inside the jitted step; HBM holds the 7.5 GiB int8 tree
+    + paged KV (512-token contexts at this batch)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm._internal.engine import EngineConfig, LLMEngine, Request
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+    from ray_tpu.models.quant import (
+        dequantize_tree,
+        quantized_bytes,
+        random_quantized_like,
+    )
+
+    import dataclasses
+    import math
+
+    cfg = dataclasses.replace(LlamaConfig.llama3_8b(),
+                              max_seq_len=1024, remat=False)
+    model = LlamaModel(cfg)
+    shape = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))["params"])
+    n_params = sum(math.prod(l.shape) for l in jax.tree.leaves(shape))
+    qp = random_quantized_like(shape)
+    eng = LLMEngine(model, qp, EngineConfig(
+        max_seqs=batch, page_size=64, max_pages_per_seq=8,
+        decode_steps=8), param_transform=dequantize_tree)
+    _serving_wave(eng, batch=batch, prompt_len=prompt_len,
+                  max_tokens=8)  # warm
+    n, dt, ttft = _serving_wave(eng, batch=batch, prompt_len=prompt_len,
+                                max_tokens=max_tokens)
+    return {"params": n_params, "weight_bytes": quantized_bytes(qp),
+            "tokens_per_s": n / dt, "ttft_s": ttft, "batch": batch}
+
+
 def mnist_trainer_bench(ray_tpu_mod, *, epochs: int = 3) -> Dict[str, Any]:
     """BASELINE config 1: single-worker MNIST-shaped MLP DataParallelTrainer.
 

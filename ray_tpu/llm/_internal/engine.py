@@ -87,10 +87,16 @@ class LLMEngine:
     serve/deployments/llm/vllm/vllm_models.py:125; here TP is native).
     """
 
-    def __init__(self, model, params, cfg: EngineConfig, mesh=None):
+    def __init__(self, model, params, cfg: EngineConfig, mesh=None,
+                 param_transform=None):
         self.model = model
         self.cfg = cfg
         self.mesh = mesh
+        # In-jit params hook (e.g. models/quant.py dequantize_tree): HBM
+        # holds the transformed-INPUT tree (int8), the jitted step
+        # reconstructs compute-dtype weights where XLA fuses the converts
+        # into the consuming matmuls.
+        self.param_transform = param_transform
         mcfg = model.cfg
         self.cache_cfg = PagedCacheConfig(
             num_pages=cfg.resolved_num_pages() + 1,  # +1: OOB drop page
@@ -145,9 +151,12 @@ class LLMEngine:
     def _build_decode(self):
         model = self.model
         K = max(1, self.cfg.decode_steps)
+        transform = self.param_transform
 
         def one(params, caches, last_tokens, page_table, seq_lens, active,
                 temps, rng):
+            if transform is not None:
+                params = transform(params)
             # positions of the NEW token = current length (before write).
             positions = seq_lens[:, None]
             logits, new_caches = model.apply(
@@ -186,8 +195,12 @@ class LLMEngine:
             return fn
         model = self.model
 
+        transform = self.param_transform
+
         def prefill(params, caches, ids, page_table_row, true_len,
                     temps, rng):
+            if transform is not None:
+                params = transform(params)
             # ids [1, bucket]; single sequence, causal within the bucket.
             positions = jnp.arange(bucket)[None, :]
             mask = positions < true_len

@@ -1,0 +1,70 @@
+"""Int8 weight quantization for serving (models/quant.py; reference
+serves quantized 8B+ models through vLLM's kernels — here quantization is
+a pytree transform dequantized inside the jitted step)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.quant import (
+    dequantize_tree,
+    quantize_tree,
+    quantized_bytes,
+    random_quantized_like,
+)
+
+
+def test_quantize_roundtrip_accuracy():
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 512)) * 0.05
+    tree = {"layer": {"kernel": w,
+                      "bias": jnp.ones((512,), jnp.float32)}}
+    q = quantize_tree(tree)
+    assert q["layer"]["kernel"]["__q__"].dtype == jnp.int8
+    # vectors stay unquantized
+    assert q["layer"]["bias"].dtype == jnp.float32
+    dq = dequantize_tree(q, jnp.float32)
+    err = float(jnp.abs(dq["layer"]["kernel"] - w).max()
+                / jnp.abs(w).max())
+    assert err < 0.02, err
+
+
+def test_quantized_bytes_counts_int8():
+    w = jnp.ones((128, 128), jnp.float32)
+    q = quantize_tree({"k": w})
+    # int8 payload + bf16 scales, far below the fp32 original
+    assert quantized_bytes(q) < w.size * 4 / 3
+
+
+def test_random_quantized_like_matches_skeleton():
+    shape = jax.eval_shape(
+        lambda: {"a": jnp.zeros((64, 128), jnp.bfloat16),
+                 "b": jnp.zeros((128,), jnp.bfloat16)})
+    q = random_quantized_like(shape, min_size=64)
+    assert q["a"]["__q__"].shape == (64, 128)
+    assert q["a"]["__q__"].dtype == jnp.int8
+    assert q["b"].shape == (128,)
+    vals = np.asarray(q["a"]["__q__"])
+    assert vals.min() >= -127 and vals.max() <= 127
+
+
+def test_engine_serves_from_int8_params():
+    """The engine decodes with int8 weights via param_transform; HBM holds
+    the int8 tree and dequant happens inside the jitted step."""
+    from ray_tpu.llm._internal.engine import EngineConfig, LLMEngine, Request
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(vocab_size=256)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    qp = quantize_tree(params, min_size=64)
+    eng = LLMEngine(
+        model, qp,
+        EngineConfig(max_seqs=2, page_size=4, max_pages_per_seq=16,
+                     decode_steps=1),
+        param_transform=lambda p: dequantize_tree(p, jnp.float32))
+    eng.add_request(Request("r", [5, 17, 42], max_tokens=5))
+    toks = []
+    while eng.has_work():
+        toks.extend(t.token for t in eng.step())
+    assert len(toks) == 5
