@@ -125,6 +125,24 @@ class Autoscaler:
                     demand.extend(pg.get("bundles", []))
             for actor in state.list_actors(state="PENDING_CREATION"):
                 demand.append({"CPU": 1.0})
+            # Task demand: unsatisfied lease shapes reported by nodelets
+            # on their heartbeats (reference: raylet ResourceLoad). The
+            # reports linger ~30s node-side, so drop shapes that some
+            # alive node can now satisfy — otherwise a satisfied burst
+            # keeps launching nodes for several reconcile cycles.
+            nodes = state.list_nodes()
+            avail = [n.get("resources_available") or {}
+                     for n in nodes if n.get("alive")]
+
+            def satisfiable(shape: Dict[str, float]) -> bool:
+                return any(all(a.get(k, 0.0) >= v
+                               for k, v in shape.items())
+                           for a in avail)
+
+            for node in nodes:
+                if node.get("alive"):
+                    demand.extend(s for s in (node.get("demand") or [])
+                                  if not satisfiable(s))
         except Exception:
             logger.exception("autoscaler demand poll failed")
         return demand

@@ -98,6 +98,9 @@ class NodeInfo:
         self.labels = labels
         self.alive = True
         self.last_heartbeat = time.monotonic()
+        # unsatisfied lease shapes from the latest heartbeat (autoscaler
+        # task-demand signal)
+        self.demand: List[Dict[str, float]] = []
 
 
 ACTOR_PENDING = "PENDING_CREATION"
@@ -362,6 +365,7 @@ class GcsServer:
     async def rpc_heartbeat(
         self, node_id: bytes, resources_available: Dict[str, float],
         load: Optional[Dict[str, Any]] = None,
+        demand: Optional[List[Dict[str, float]]] = None,
     ) -> Dict[str, Any]:
         nid = NodeID(node_id)
         info = self.nodes.get(nid)
@@ -372,6 +376,7 @@ class GcsServer:
             return {"ok": False, "reregister": True}
         info.last_heartbeat = time.monotonic()
         info.resources_available = resources_available
+        info.demand = demand or []
         return {"ok": True}
 
     async def rpc_list_nodes(self) -> List[Dict[str, Any]]:
@@ -384,6 +389,7 @@ class GcsServer:
                 "resources_available": n.resources_available,
                 "object_store_path": n.object_store_path,
                 "labels": n.labels,
+                "demand": n.demand,
             }
             for n in self.nodes.values()
         ]

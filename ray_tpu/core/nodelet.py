@@ -111,6 +111,9 @@ class Nodelet:
         # nodes on one filesystem).
         self._worker_log_dir = os.path.join(
             self.session_dir, "logs", self.node_id.hex()[:8])
+        # shape-key -> (resources, last_seen): lease shapes this node
+        # couldn't satisfy (autoscaler demand signal via heartbeat).
+        self._unmet_demand: Dict[str, Tuple[Dict[str, float], float]] = {}
 
         from ray_tpu._private.accelerators import detect_resources
 
@@ -422,9 +425,16 @@ class Nodelet:
                     "node_id": self.node_id.binary(),
                 }
             if not block:
+                if pg_bundle is None:
+                    # PG-bundle leases are pinned to this node; a new node
+                    # could never satisfy them (pending-PG demand is
+                    # counted separately by the autoscaler).
+                    self._record_unmet_demand(resources)
                 return {"ok": False, "error": "resources unavailable",
                         "retry": True}
             if time.monotonic() > deadline:
+                if pg_bundle is None:
+                    self._record_unmet_demand(resources)
                 return {"ok": False, "error": "lease timeout", "retry": True}
             event = asyncio.Event()
             self._lease_waiters.append(event)
@@ -615,6 +625,21 @@ class Nodelet:
     # ------------------------------------------------------------------
     # Background loops
     # ------------------------------------------------------------------
+    def _record_unmet_demand(self, resources: Dict[str, float]) -> None:
+        """Resource shapes this node could not lease — carried on the next
+        heartbeat so the autoscaler sees TASK demand, not just pending
+        actors/PGs (reference: resource_demand in the load report,
+        raylet's ResourceLoad)."""
+        key = repr(sorted(resources.items()))
+        self._unmet_demand[key] = (dict(resources), time.monotonic())
+
+    def _demand_snapshot(self) -> List[Dict[str, float]]:
+        cutoff = time.monotonic() - 30.0
+        for key, (_, ts) in list(self._unmet_demand.items()):
+            if ts < cutoff:
+                del self._unmet_demand[key]
+        return [shape for shape, _ in self._unmet_demand.values()]
+
     async def _heartbeat_loop(self) -> None:
         cfg = get_config()
         while not self._shutting_down:
@@ -623,6 +648,7 @@ class Nodelet:
                     "heartbeat",
                     node_id=self.node_id.binary(),
                     resources_available=dict(self.resources_available),
+                    demand=self._demand_snapshot(),
                 )
                 if not reply.get("ok") and reply.get("reregister"):
                     # GCS declared us dead (transient stall past the failure

@@ -4,6 +4,7 @@ serve/schema.py — trimmed to the dataclasses the runtime needs)."""
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
@@ -34,3 +35,4 @@ class ReplicaInfo:
     replica_id: str
     actor: Any  # ActorHandle
     healthy: bool = True
+    created_at: float = dataclasses.field(default_factory=time.monotonic)

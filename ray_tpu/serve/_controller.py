@@ -179,7 +179,26 @@ class ServeController:
                     try:
                         ray_tpu.get(info.actor.check_health.remote(),
                                     timeout=10)
-                    except Exception:
+                        info.healthy = True
+                    except Exception as e:
+                        # Startup grace: a replica still waiting on worker
+                        # spawn + model load (ActorUnavailable / pending)
+                        # must not be killed and respawned in a loop —
+                        # that starves the deployment forever on a loaded
+                        # host. Only replace once it EXCEEDS the grace
+                        # window or is definitively dead. While in grace
+                        # it is marked unhealthy so routing skips it.
+                        from ray_tpu.exceptions import ActorDiedError
+
+                        age = time.monotonic() - info.created_at
+                        dead = isinstance(e, ActorDiedError)
+                        if not dead and age < 180.0:
+                            info.healthy = False
+                            logger.info(
+                                "replica %s of %s not ready yet "
+                                "(%.0fs): %r", info.replica_id, name,
+                                age, e)
+                            continue
                         logger.warning(
                             "replica %s of %s unhealthy; replacing",
                             info.replica_id, name)

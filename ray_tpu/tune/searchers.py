@@ -49,6 +49,12 @@ class Searcher:
                           error: bool = False) -> None:
         pass
 
+    def observe(self, config: Dict[str, Any],
+                result: Dict[str, Any]) -> None:
+        """Feed a pre-existing (config, result) observation — used by
+        Tuner.restore to warm a fresh searcher with completed trials."""
+        pass
+
 
 class RandomSearcher(Searcher):
     """IID sampling through the Searcher interface (baseline)."""
@@ -91,10 +97,14 @@ class TPESearcher(Searcher):
         cfg = self._live.pop(trial_id, None)
         if cfg is None or error or not result:
             return
+        self.observe(cfg, result)
+
+    def observe(self, config: Dict[str, Any],
+                result: Dict[str, Any]) -> None:
         value = result.get(self.metric)
         if value is None:
             return
-        self._obs.append((cfg, float(value)))
+        self._obs.append((config, float(value)))
 
     # -- suggestion ------------------------------------------------------
     def suggest(self, trial_id: str) -> Dict[str, Any]:

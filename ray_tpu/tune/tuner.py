@@ -42,6 +42,10 @@ class TuneConfig:
     metric: Optional[str] = None
     mode: str = "max"
     scheduler: Any = None
+    # Model-based config suggestion (tune/searchers.py Searcher). When set,
+    # trials are created LAZILY so later suggestions see earlier results
+    # (reference: tune/search/search_generator.py).
+    search_alg: Any = None
     seed: Optional[int] = None
     max_failures_per_trial: int = 0
 
@@ -143,6 +147,10 @@ class _TuneController:
         self.run_cfg = run_cfg
         self.storage = storage
         self.scheduler = tune_cfg.scheduler or FIFOScheduler()
+        self.searcher = tune_cfg.search_alg
+        self._searcher_exhausted = False
+        if self.searcher is not None:
+            self.searcher.set_search_space(param_space)
         self.state_path = os.path.join(storage, "experiment_state.json")
         if restore and os.path.exists(self.state_path):
             with open(self.state_path) as f:
@@ -152,6 +160,18 @@ class _TuneController:
                 # Unfinished trials restart from their latest checkpoint.
                 if t.status not in (TERMINATED, ERROR):
                     t.status = PENDING
+                elif self.searcher is not None and t.last_result:
+                    # Replay finished trials into the restored searcher so
+                    # its model resumes warm, not from the startup phase.
+                    try:
+                        self.searcher.observe(t.config, t.last_result)
+                    except Exception:
+                        logger.exception("searcher observe failed")
+        elif self.searcher is not None:
+            # Lazy creation: _start_pending asks the searcher as slots
+            # free up, so suggestion N sees results of trials < N.
+            self.trials = []
+            self._persist()
         else:
             configs = generate_configs(param_space, tune_cfg.num_samples,
                                        tune_cfg.seed)
@@ -169,7 +189,7 @@ class _TuneController:
     # ------------------------------------------------------------------
     def run(self) -> ResultGrid:
         try:
-            while self._unfinished():
+            while self._unfinished() or self._more_to_create():
                 self._start_pending()
                 self._poll_running()
                 self._persist()
@@ -192,11 +212,31 @@ class _TuneController:
     def _unfinished(self) -> List[Trial]:
         return [t for t in self.trials if t.status in (PENDING, RUNNING)]
 
+    def _more_to_create(self) -> bool:
+        return (self.searcher is not None
+                and not self._searcher_exhausted
+                and len(self.trials) < self.tune_cfg.num_samples)
+
     def _running(self) -> List[Trial]:
         return [t for t in self.trials if t.status == RUNNING]
 
     def _start_pending(self) -> None:
         cap = max(1, self.tune_cfg.max_concurrent_trials)
+        # Searcher-driven: create trials lazily up to num_samples.
+        while self._more_to_create() and len(self._running()) < cap:
+            tid = f"trial_{len(self.trials):04d}"
+            cfg = self.searcher.suggest(tid)
+            if cfg is None:
+                # Searcher exhausted (e.g. finite space < num_samples):
+                # stop asking, or run() would spin on _more_to_create.
+                self._searcher_exhausted = True
+                break
+            t = Trial(trial_id=tid, config=cfg)
+            self.trials.append(t)
+            on_add = getattr(self.scheduler, "on_trial_add", None)
+            if callable(on_add):
+                on_add(t)
+            self._start_trial(t)
         for t in self.trials:
             if len(self._running()) >= cap:
                 break
@@ -243,6 +283,7 @@ class _TuneController:
             elif poll["finished"]:
                 t.status = TERMINATED
                 self._stop_actor(t)
+                self._notify_searcher_complete(t)
 
     def _on_result(self, t: Trial, item: Dict[str, Any]) -> None:
         metrics = dict(item["metrics"])
@@ -258,14 +299,26 @@ class _TuneController:
                 shutil.rmtree(dest, ignore_errors=True)
             shutil.move(item["checkpoint_path"], dest)
             t.checkpoint_path = dest
+        if self.searcher is not None:
+            self.searcher.on_trial_result(t.trial_id, metrics)
         decision = self.scheduler.on_result(t, metrics, self.trials)
         if decision == STOP:
             logger.info("scheduler stopped %s at iter %d", t.trial_id,
                         t.iteration)
             t.status = TERMINATED
             self._stop_actor(t)
+            self._notify_searcher_complete(t)
         elif isinstance(decision, Exploit):
             self._exploit(t, decision)
+
+    def _notify_searcher_complete(self, t: Trial,
+                                  error: bool = False) -> None:
+        if self.searcher is not None:
+            try:
+                self.searcher.on_trial_complete(
+                    t.trial_id, t.last_result or None, error=error)
+            except Exception:
+                logger.exception("searcher on_trial_complete failed")
 
     def _exploit(self, t: Trial, decision: Exploit) -> None:
         src = next((x for x in self.trials
@@ -291,6 +344,7 @@ class _TuneController:
         else:
             t.status = ERROR
             t.error = error
+            self._notify_searcher_complete(t, error=True)
 
     def _persist(self) -> None:
         tmp = self.state_path + ".tmp"

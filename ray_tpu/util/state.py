@@ -25,6 +25,7 @@ def list_nodes() -> List[Dict[str, Any]]:
             "resources_total": n.get("resources_total", {}),
             "resources_available": n.get("resources_available", {}),
             "labels": n.get("labels", {}),
+            "demand": n.get("demand", []),
         })
     return out
 
