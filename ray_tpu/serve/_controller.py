@@ -78,6 +78,24 @@ class ServeController:
     # ------------------------------------------------------------------
     # Discovery (handles + proxy)
     # ------------------------------------------------------------------
+    async def wait_routing(self, known_version: int = -1,
+                           timeout: float = 30.0
+                           ) -> Optional[Dict[str, Any]]:
+        """Long-poll: return the routing table once it is NEWER than
+        known_version, or None at timeout (reference:
+        serve/_private/long_poll.py:222 LongPollHost.listen_for_change).
+        Async so parked polls ride the actor's event loop instead of
+        pinning executor threads — one outstanding call per handle."""
+        import asyncio
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            routing = self.get_routing(known_version)
+            if routing is not None:
+                return routing
+            await asyncio.sleep(0.05)
+        return None
+
     def get_routing(self, known_version: int = -1
                     ) -> Optional[Dict[str, Any]]:
         """Replica handles + route prefixes, or None when unchanged."""

@@ -29,6 +29,7 @@ class _RouterCache:
         # (reference: the router prefers replicas with the model loaded).
         self.model_replica: Dict[str, str] = {}
         self.lock = threading.Lock()
+        self.poller_started = False
 
 
 class DeploymentResponse:
@@ -107,10 +108,43 @@ class DeploymentHandle:
         return self.options(method_name=name)
 
     # -- routing ---------------------------------------------------------
+    # The controller PUSHES table changes through a long-poll kept open by
+    # a background thread (reference: long_poll.py LongPollClient); the
+    # TTL re-fetch remains only as the bootstrap/fallback path, so scale
+    # events reach handles in ~100ms instead of up to _ROUTING_TTL_S.
+    def _ensure_poller(self) -> None:
+        c = self._cache
+        with c.lock:
+            if c.poller_started:
+                return
+            c.poller_started = True
+        threading.Thread(target=self._poll_loop, daemon=True,
+                         name="serve-router-longpoll").start()
+
+    def _poll_loop(self) -> None:
+        c = self._cache
+        while True:
+            try:
+                if not ray_tpu.is_initialized():
+                    return
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                routing = ray_tpu.get(
+                    controller.wait_routing.remote(c.version, 25.0),
+                    timeout=40)
+                if routing is not None:
+                    with c.lock:
+                        c.version = routing["version"]
+                        c.deployments = routing["deployments"]
+                        c.fetched_at = time.monotonic()
+            except Exception:
+                # controller restarting / shutdown: back off, retry
+                time.sleep(1.0)
+
     def _refresh(self, force: bool = False) -> None:
         c = self._cache
         now = time.monotonic()
-        if not force and now - c.fetched_at < _ROUTING_TTL_S and c.deployments:
+        if not force and c.deployments and (
+                c.poller_started or now - c.fetched_at < _ROUTING_TTL_S):
             return
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
         routing = ray_tpu.get(
@@ -121,6 +155,7 @@ class DeploymentHandle:
             if routing is not None:
                 c.version = routing["version"]
                 c.deployments = routing["deployments"]
+        self._ensure_poller()
 
     def _pick_replica(self, args: tuple = (), kwargs: Optional[dict] = None):
         c = self._cache

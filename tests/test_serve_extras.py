@@ -94,3 +94,35 @@ def test_prefix_router_affinity(serve_shutdown):
     other = [h.remote(prompt_ids=[99 - i for i in range(20)]).result(
         timeout=30) for _ in range(3)]
     assert len(set(other)) == 1  # the other prefix is sticky too
+
+
+def test_routing_longpoll_pushes_scale_events(serve_shutdown):
+    """Scale events reach handles via the controller long-poll in well
+    under the old 2s TTL (reference: serve/_private/long_poll.py)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve._common import CONTROLLER_NAME
+
+    @serve.deployment(name="LP", num_replicas=1)
+    class LP:
+        def __call__(self, request):
+            return "ok"
+
+    handle = serve.run(LP.bind())
+    assert handle.remote({}).result(timeout=60) == "ok"
+    # poller is live after first use
+    assert handle._cache.poller_started
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    v0 = handle._cache.version
+    # redeploy with 2 replicas -> version bump must reach the handle fast
+    serve.run(LP.options(num_replicas=2).bind())
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if handle._cache.version > v0 and len(
+                handle._cache.deployments["LP"]["replicas"]) == 2:
+            break
+        time.sleep(0.05)
+    assert len(handle._cache.deployments["LP"]["replicas"]) == 2
