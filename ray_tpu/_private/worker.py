@@ -938,6 +938,7 @@ class Worker:
             # completion event directly — the reply callback (loop thread)
             # sets it, one futex wake, no coroutine scheduling at all.
             ref = refs[0]
+            t_block0 = time.monotonic()
             entry = self.memory_store.get_blocking(ref.id, timeout)
             if entry is None:
                 raise GetTimeoutError(f"timed out resolving {ref}")
@@ -954,7 +955,12 @@ class Worker:
                     if is_error:
                         raise value
                     return [self._maybe_device(value)]
-            # Remote/spilled/device entries: the async machinery owns those.
+            # Remote/spilled/device entries: the async machinery owns those
+            # — with only the REMAINING slice of the caller's budget (the
+            # blocking wait above may already have consumed part of it, and
+            # ray.get(timeout=T) must not block ~2T).
+            if timeout is not None:
+                timeout = max(0.0, timeout - (time.monotonic() - t_block0))
         coro = self._get_async(refs, timeout)
         outer = None if timeout is None else timeout + 5
         return self.loop_thread.run(coro, timeout=outer)

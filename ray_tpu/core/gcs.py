@@ -438,6 +438,17 @@ class GcsServer:
         self.mark_dirty()
         return existed
 
+    async def rpc_kv_cas(self, key: str, expect: Optional[bytes],
+                         value: bytes) -> bool:
+        """Atomic compare-and-swap (the GCS event loop serializes RPCs):
+        writes `value` iff the current value is exactly `expect`
+        (None = key absent). Lease-style leader claims build on this."""
+        if self.kv.get(key) != expect:
+            return False
+        self.kv[key] = value
+        self.mark_dirty()
+        return True
+
     async def rpc_kv_get(self, key: str) -> Optional[bytes]:
         return self.kv.get(key)
 

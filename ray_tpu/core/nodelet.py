@@ -201,29 +201,74 @@ class Nodelet:
     # Log pipeline (reference: python/ray/_private/log_monitor.py — tail
     # worker log files → GCS pubsub → driver stdout)
     # ------------------------------------------------------------------
+    async def _claim_component_log_lease(self, ttl: float
+                                         ) -> Tuple[bool, bool]:
+        """Refresh/claim the component-log-tailing lease. The value is
+        (node_id, wall-clock stamp); a stamp older than ttl — or a legacy/
+        undecodable value — is claimable. kv_cas makes the takeover atomic
+        under concurrent claimants. Returns (leader, took_over): took_over
+        means the key previously named another node, so history already
+        published by the old leader must not be re-shipped."""
+        import pickle
+
+        key = "logtail:component_leader"
+        me = self.node_id.binary()
+        cur = await self._gcs.call("kv_get", key=key)
+        owner: Optional[bytes] = None
+        stamp = 0.0
+        if cur:
+            try:
+                owner, stamp = pickle.loads(cur)
+            except Exception:
+                pass  # legacy first-writer-wins format: treat as stale
+        now = time.time()
+        if owner != me and owner is not None and now - stamp <= ttl:
+            return False, False
+        new = pickle.dumps((me, now))
+        won = bool(await self._gcs.call("kv_cas", key=key,
+                                        expect=cur, value=new))
+        return won, won and cur is not None and owner != me
+
     async def _log_monitor_loop(self) -> None:
         # Tail only THIS node's worker logs. Multi-node clusters sharing one
         # filesystem (cluster_utils, fake TPU-pod transport) would otherwise
         # have N nodelets each republishing every worker's output with the
         # wrong node label. Component logs (gcs.log, nodelet-*.log) live at
-        # the top level of the shared logs dir; exactly one nodelet claims
-        # them via an atomic first-writer-wins kv key.
+        # the top level of the shared logs dir; exactly one nodelet holds a
+        # LEASED kv key for them (timestamp refreshed while alive) so that a
+        # dead leader — or stale node ids left in a persistent sqlite-backed
+        # store across cluster restarts — is replaced instead of orphaning
+        # component-log tailing forever.
         log_dir = self._worker_log_dir
-        component_dir: Optional[str] = None
+        component_dir = ""
+        lease_ttl = 10.0
+        next_lease_at = 0.0
         offsets: Dict[str, int] = {}
         partial: Dict[str, bytes] = {}
         while not self._shutting_down:
             await asyncio.sleep(0.5)
             try:
-                if component_dir is None and self._gcs is not None:
-                    existed = await self._gcs.call(
-                        "kv_put", key="logtail:component_leader",
-                        value=self.node_id.binary(), overwrite=False)
-                    leader = not existed or (await self._gcs.call(
-                        "kv_get", key="logtail:component_leader")
-                    ) == self.node_id.binary()
+                now = time.time()
+                if self._gcs is not None and now >= next_lease_at:
+                    leader, took_over = (
+                        await self._claim_component_log_lease(lease_ttl))
                     component_dir = (os.path.join(self.session_dir, "logs")
                                      if leader else "")
+                    if took_over and component_dir:
+                        # Start tailing at the CURRENT end of each component
+                        # file: the dead leader already published history,
+                        # and re-shipping it would duplicate driver output.
+                        for n in sorted(os.listdir(component_dir)):
+                            p = os.path.join(component_dir, n)
+                            if os.path.isfile(p) and p not in offsets:
+                                try:
+                                    offsets[p] = os.path.getsize(p)
+                                except OSError:
+                                    pass
+                    # Holders refresh well inside the ttl; others probe at
+                    # ttl pace so takeover happens within ~2 ttl.
+                    next_lease_at = now + (lease_ttl / 3 if leader
+                                           else lease_ttl)
                 names = [
                     (log_dir, n)
                     for n in (sorted(os.listdir(log_dir))

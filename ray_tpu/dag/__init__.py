@@ -24,6 +24,7 @@ per submitter.
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
@@ -156,6 +157,7 @@ class CompiledDAG:
         self._executions = 0
         self._channels: List[Any] = []
         self._loop_refs: List[Any] = []
+        self._stage_error: Optional[BaseException] = None
         self._exec_seq = 0
         self._next_out_seq = 0
         self._out_buffer: Dict[int, Any] = {}
@@ -262,11 +264,63 @@ class CompiledDAG:
 
         return w.loop_thread.run(probe())
 
+    def _check_stage_liveness(self) -> None:
+        """A pinned stage loop replies only at teardown — so any completed
+        loop ref mid-run means its actor died or the loop crashed. Poison
+        the DAG so every pending/later ref raises instead of spinning on a
+        channel nobody will write again (reference: aDAG tears down
+        channels on actor death, compiled_dag_node.py teardown path)."""
+        if self._stage_error is not None:
+            raise self._stage_error
+        if not self._loop_refs:
+            return
+        done, _ = ray_tpu.wait(list(self._loop_refs),
+                               num_returns=1, timeout=0)
+        if not done:
+            return
+        from ray_tpu.exceptions import ActorDiedError
+
+        try:
+            ray_tpu.get(done[0])
+            err: BaseException = ActorDiedError(
+                "compiled-DAG stage loop exited before teardown")
+        except BaseException as e:  # noqa: BLE001
+            err = e
+        self._stage_error = err
+        # Close every channel: blocked pinned loops and readers unblock
+        # with ChannelClosed instead of waiting forever.
+        for ch in self._channels:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        raise err
+
     def _collect_output(self, seq: int, timeout: Optional[float] = None):
         """Outputs arrive strictly in execute() order on the last channel;
-        buffer values for refs resolved out of order."""
+        buffer values for refs resolved out of order. Reads run in bounded
+        slices with a stage-liveness check between them, so a dead stage
+        actor surfaces as ActorDiedError rather than a hang."""
+        from ray_tpu.experimental.channel import ChannelClosed
+
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
         while seq not in self._out_buffer:
-            value = self._channels[-1].read(timeout)
+            if self._stage_error is not None:
+                raise self._stage_error
+            slice_t = 0.2
+            if deadline is not None:
+                slice_t = min(slice_t, max(0.0, deadline - time.monotonic()))
+            try:
+                value = self._channels[-1].read(slice_t)
+            except TimeoutError:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise
+                self._check_stage_liveness()
+                continue
+            except ChannelClosed:
+                self._check_stage_liveness()
+                raise
             self._out_buffer[self._next_out_seq] = value
             self._next_out_seq += 1
         self._inflight = [r for r in self._inflight if r._seq != seq]
@@ -299,6 +353,8 @@ class CompiledDAG:
             input_val = input_args
         self._executions += 1
         if self._channel_mode:
+            if self._stage_error is not None:
+                raise self._stage_error
             # Pipelined: the rings hold nslots values per edge; bound the
             # in-flight window by draining the OLDEST ref when full (its
             # error, if any, stays cached on that ref — it must not poison
@@ -313,7 +369,19 @@ class CompiledDAG:
                     oldest.get()
                 except Exception:  # noqa: BLE001
                     pass
-            self._channels[0].write(input_val, timeout=600.0)
+            # Sliced write + liveness check: a dead middle stage stalls
+            # the ring and must surface, not block for the full timeout.
+            # Encode once; only the ring-slot claim is retried.
+            payload = self._channels[0].encode(input_val)
+            wr_deadline = time.monotonic() + 600.0
+            while True:
+                try:
+                    self._channels[0].write_payload(payload, timeout=0.2)
+                    break
+                except TimeoutError:
+                    if time.monotonic() >= wr_deadline:
+                        raise
+                    self._check_stage_liveness()
             ref = CompiledDAGRef(self, self._exec_seq)
             self._exec_seq += 1
             self._inflight.append(ref)

@@ -1,6 +1,6 @@
 """Mutable channels for compiled-DAG fast paths (reference:
 python/ray/experimental/channel/)."""
 
-from ray_tpu.experimental.channel.shm_channel import ShmChannel
+from ray_tpu.experimental.channel.shm_channel import ChannelClosed, ShmChannel
 
-__all__ = ["ShmChannel"]
+__all__ = ["ChannelClosed", "ShmChannel"]

@@ -84,7 +84,11 @@ class ShmChannel:
         return spins
 
     # -- writer ----------------------------------------------------------
-    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+    @staticmethod
+    def encode(value: Any) -> bytes:
+        """Serialize once, write (or retry-write) many: callers that slice
+        a long write into bounded attempts pass the encoded payload to
+        write_payload instead of re-pickling per attempt."""
         buffers = []
         body = pickle.dumps(value, protocol=5,
                             buffer_callback=buffers.append)
@@ -94,7 +98,13 @@ class ShmChannel:
             raw = b.raw()
             parts.append(struct.pack("<Q", raw.nbytes))
             parts.append(raw if isinstance(raw, bytes) else bytes(raw))
-        payload = b"".join(parts)
+        return b"".join(parts)
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        self.write_payload(self.encode(value), timeout)
+
+    def write_payload(self, payload: bytes,
+                      timeout: Optional[float] = None) -> None:
         if len(payload) > self.capacity:
             raise ValueError(
                 f"value needs {len(payload)} bytes; channel slot is "

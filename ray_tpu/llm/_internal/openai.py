@@ -74,13 +74,19 @@ class OpenAIServer:
         try:
             if suffix.rstrip("/").endswith("/models"):
                 return self._models()
+            # Tokenize/validate HERE for the stream paths too: the stream
+            # handlers are generators, so an error raised inside them would
+            # only fire at first iteration (in the proxy's executor, as a
+            # 500) instead of this documented 400.
             if suffix.rstrip("/").endswith("/chat/completions"):
                 if stream:
-                    return self._chat_stream(body)
+                    return self._chat_stream(
+                        self._gen_kwargs(body), self._chat_ids(body))
                 return self._chat(body)
             if suffix.rstrip("/").endswith("/completions"):
                 if stream:
-                    return self._completions_stream(body)
+                    return self._completions_stream(
+                        self._gen_kwargs(body), self._prompt_ids(body))
                 return self._completions(body)
         except ValueError as e:
             return _error(400, str(e))
@@ -147,12 +153,12 @@ class OpenAIServer:
         }
 
     # -- streaming (SSE) -------------------------------------------------
-    def _completions_stream(self, body: Dict[str, Any]) -> Iterator[Any]:
-        ids = self._prompt_ids(body)
+    def _completions_stream(self, gen_kwargs: Dict[str, Any],
+                            ids: List[int]) -> Iterator[Any]:
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
         yield {"__http__": {"content_type": "text/event-stream"}}
         dec = _IncrementalDecoder(self.tokenizer)
-        for item in self.server.generate(ids, **self._gen_kwargs(body)):
+        for item in self.server.generate(ids, **gen_kwargs):
             delta = dec.push(item["token"])
             if delta:
                 yield _sse({
@@ -166,8 +172,8 @@ class OpenAIServer:
             "choices": [{"index": 0, "text": "", "finish_reason": "stop"}]})
         yield "data: [DONE]\n\n"
 
-    def _chat_stream(self, body: Dict[str, Any]) -> Iterator[Any]:
-        ids = self._chat_ids(body)
+    def _chat_stream(self, gen_kwargs: Dict[str, Any],
+                     ids: List[int]) -> Iterator[Any]:
         rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         yield {"__http__": {"content_type": "text/event-stream"}}
         yield _sse({
@@ -177,7 +183,7 @@ class OpenAIServer:
                          "delta": {"role": "assistant", "content": ""},
                          "finish_reason": None}]})
         dec = _IncrementalDecoder(self.tokenizer)
-        for item in self.server.generate(ids, **self._gen_kwargs(body)):
+        for item in self.server.generate(ids, **gen_kwargs):
             delta = dec.push(item["token"])
             if delta:
                 yield _sse({

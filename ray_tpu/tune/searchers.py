@@ -267,8 +267,13 @@ class _CategoricalModel:
                        for v in support]
         self.p_bad = [(bad.count(v) + 0.5) / (len(bad) + 0.5 * s)
                       for v in support]
+        # Normalize BOTH densities: log_ratio must compare probability
+        # distributions, or mixed categorical/numeric spaces pick up a
+        # constant per-dimension offset that skews candidate scoring.
         total = sum(self.p_good)
         self.p_good = [p / total for p in self.p_good]
+        total_b = sum(self.p_bad)
+        self.p_bad = [p / total_b for p in self.p_bad]
 
     PRIOR_P = 0.25
 

@@ -85,6 +85,41 @@ def test_dag_channel_error_propagates(ray_start_regular):
     dag.teardown()
 
 
+def test_dag_channel_actor_death_raises(ray_start_regular):
+    """A dead stage actor must surface as RayActorError on pending refs
+    instead of hanging the driver in ShmChannel.read (reference: aDAG
+    channel teardown on actor death)."""
+    import time
+
+    from ray_tpu.exceptions import RayActorError
+
+    @ray_tpu.remote
+    class Stage:
+        def work(self, x):
+            return x + 1
+
+    s1, s2 = Stage.remote(), Stage.remote()
+    for s in (s1, s2):
+        ray_tpu.get(s.work.remote(0))
+    with InputNode() as inp:
+        node = s2.work.bind(s1.work.bind(inp))
+    dag = node.experimental_compile()
+    if not dag._channel_mode:
+        pytest.skip("channel mode unavailable in this environment")
+    assert ray_tpu.get(dag.execute(1)) == 3
+    ray_tpu.kill(s1)
+    time.sleep(1.0)
+    t0 = time.monotonic()
+    with pytest.raises(RayActorError):
+        ref = dag.execute(2)
+        ray_tpu.get(ref, timeout=60)
+    assert time.monotonic() - t0 < 45
+    # later executes fail fast on the cached poison
+    with pytest.raises(RayActorError):
+        ray_tpu.get(dag.execute(3), timeout=60)
+    dag.teardown()
+
+
 def test_dag_nonlinear_falls_back_to_actor_push(ray_start_regular):
     from ray_tpu.dag import MultiOutputNode
 
