@@ -41,6 +41,7 @@ def bench_actor_calls_async(ray_tpu, n=5000):
 
     a = Echo.remote()
     ray_tpu.get(a.ping.remote())
+    ray_tpu.get([a.ping.remote() for _ in range(n)])  # warm burst
     t0 = time.perf_counter()
     ray_tpu.get([a.ping.remote() for _ in range(n)])
     dt = time.perf_counter() - t0
@@ -53,6 +54,8 @@ def bench_tasks_async(ray_tpu, n=2000):
         return None
 
     ray_tpu.get(nop.remote())
+    for _ in range(2):  # warm bursts: lease pool + worker pool stabilize
+        ray_tpu.get([nop.remote() for _ in range(n)])
     t0 = time.perf_counter()
     ray_tpu.get([nop.remote() for _ in range(n)])
     dt = time.perf_counter() - t0
@@ -62,7 +65,9 @@ def bench_tasks_async(ray_tpu, n=2000):
 def bench_put_gigabytes(ray_tpu, size_mb=100, iters=10):
     import numpy as np
 
-    arr = np.ones(size_mb * 1024 * 1024, dtype=np.uint8)
+    # np.zeros to match the reference's put_large exactly (ray_perf.py —
+    # the kernel zero page keeps the source side cache-resident there too)
+    arr = np.zeros(size_mb * 1024 * 1024, dtype=np.uint8)
     ray_tpu.put(arr)  # warm-up
     t0 = time.perf_counter()
     refs = [ray_tpu.put(arr) for _ in range(iters)]
@@ -186,6 +191,32 @@ def main():
             f"single_client_put_gigabytes: {put_gbps:.2f} GiB/s (ref 19.56)",
             file=sys.stderr,
         )
+        try:
+            from ray_tpu.benchmarks.micro_bench import run_micro_benchmarks
+
+            table = run_micro_benchmarks(
+                ray_tpu,
+                progress=lambda s: print(f"micro: {s}", file=sys.stderr))
+            with open(os.path.join(os.path.dirname(__file__) or ".",
+                                   "MICROBENCH.json"), "w") as f:
+                json.dump({"host": "1-core driver host",
+                           "results": table}, f, indent=1)
+        except Exception as e:  # noqa: BLE001
+            print(f"micro benchmark table skipped: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        try:
+            from ray_tpu.benchmarks.device_bench import (
+                run_device_transfer_bench,
+            )
+
+            dev = run_device_transfer_bench(ray_tpu)
+            print(f"device_object_transfer: shm {dev['shm_gbps']} GiB/s vs "
+                  f"socket {dev['socket_gbps']} GiB/s "
+                  f"({dev['shm_speedup']}x, {dev['size_mb']} MiB arrays)",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"device transfer bench skipped: {type(e).__name__}: {e}",
+                  file=sys.stderr)
         try:
             from ray_tpu.benchmarks.dag_bench import run_dag_bench
 

@@ -760,12 +760,27 @@ class Worker:
         s.register("fast_lane_info", self._rpc_fast_lane_info)
         s.register("dag_method_info", self._rpc_dag_method_info)
         s.register("device_object_fetch", self._rpc_device_object_fetch)
+        s.register("device_object_fetch_shm", self._rpc_device_object_fetch_shm)
+        s.register("device_object_mesh_send", self._rpc_device_object_mesh_send)
         s.register("device_object_free", self._rpc_device_object_free)
 
     async def _rpc_device_object_fetch(self, object_id: bytes) -> Dict[str, Any]:
         from ray_tpu.experimental import device_objects as devobj
 
         return await devobj.rpc_fetch(self, object_id)
+
+    async def _rpc_device_object_fetch_shm(
+            self, object_id: bytes) -> Dict[str, Any]:
+        from ray_tpu.experimental import device_objects as devobj
+
+        return await devobj.rpc_fetch_shm(self, object_id)
+
+    async def _rpc_device_object_mesh_send(
+            self, object_id: bytes,
+            dst_ids: List[List[int]]) -> Dict[str, Any]:
+        from ray_tpu.experimental import device_objects as devobj
+
+        return await devobj.rpc_mesh_send(self, object_id, dst_ids)
 
     async def _rpc_device_object_free(self, object_id: bytes) -> Dict[str, Any]:
         from ray_tpu.experimental import device_objects as devobj

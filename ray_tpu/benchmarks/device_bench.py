@@ -1,0 +1,57 @@
+"""Device-object transfer bandwidth: shm staging vs socket (host) staging.
+
+Measures the same producer→consumer jax.Array handoff through both same-host
+transports (experimental/device_objects.py) so the transport choice is a
+recorded number, not an assumption. Reference counterpart: RDT GPU-object
+transfer (gpu_object_manager) whose point is exactly to beat object-store
+staging bandwidth.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+
+def run_device_transfer_bench(ray_tpu, size_mb: int = 256,
+                              iters: int = 4) -> Dict[str, Any]:
+    @ray_tpu.remote
+    class Producer:
+        def make(self, n_bytes):
+            import jax.numpy as jnp
+
+            return jnp.ones((n_bytes // 4,), jnp.float32)
+
+    @ray_tpu.remote
+    class Consumer:
+        def force(self, mode):
+            from ray_tpu.experimental import device_objects as d
+
+            if mode == "socket":
+                d.set_communicator(d.HostStagingCommunicator())
+            elif mode == "shm":
+                d.set_communicator(d.ShmStagingCommunicator())
+            else:
+                d.set_communicator(None)
+            return mode
+
+        def consume(self, x):
+            return float(x[0])
+
+    n_bytes = size_mb * 1024 * 1024
+    p, c = Producer.remote(), Consumer.remote()
+    out: Dict[str, Any] = {"size_mb": size_mb}
+    for mode in ("socket", "shm"):
+        ray_tpu.get(c.force.remote(mode))
+        # warm-up (worker spawn, jit of nothing, route setup)
+        r = p.make.options(tensor_transport="device").remote(1024)
+        ray_tpu.get(c.consume.remote(r))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ref = p.make.options(tensor_transport="device").remote(n_bytes)
+            assert ray_tpu.get(c.consume.remote(ref)) == 1.0
+        dt = time.perf_counter() - t0
+        out[f"{mode}_gbps"] = round(size_mb * iters / 1024 / dt, 3)
+    ray_tpu.get(c.force.remote("auto"))
+    out["shm_speedup"] = round(out["shm_gbps"] / out["socket_gbps"], 2)
+    return out

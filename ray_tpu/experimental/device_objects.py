@@ -3,9 +3,9 @@ without a pickle round trip.
 
 TPU-native counterpart of the reference's Ray Direct Transport / GPU objects
 (python/ray/experimental/gpu_object_manager/gpu_object_manager.py:54,
-gpu_object_store.py). On TPU, avoiding host⇄HBM staging matters more than on
-GPU: every normal object-plane hop costs a device→host copy at serialization
-(serialization.py jax handling) plus a host→device copy on use.
+gpu_object_store.py) with the aDAG accelerator-channel transport plugged in
+behind the same surface (experimental/channel/torch_tensor_nccl_channel.py,
+communicator.py:18).
 
 Design (pull-based, no driver coordination — unlike the reference, which has
 the caller orchestrate send/recv pairs through a collective group, we let the
@@ -16,23 +16,37 @@ the caller orchestrate send/recv pairs through a collective group, we let the
 - ``device_put(value)`` extracts every jax.Array from ``value`` (arbitrary
   pytree/containers), stores them locally, and puts a small
   ``DeviceObjectValue`` skeleton through the normal object plane. The
-  skeleton records (src RPC address, object id, per-tensor shape/dtype).
+  skeleton records (src RPC address, object id, per-tensor shape/dtype and —
+  when the source sits in a transfer group — its device/sharding layout).
 - Actor methods opt in with ``.options(tensor_transport="device")``: their
   return value goes through the same extraction on the *executing* actor, so
   results never leave HBM unless some other process asks for them.
 - When any process deserializes the skeleton (``ray.get`` or a task arg),
-  resolution kicks in:
-    * same process → the original jax.Array objects, zero copies;
-    * other process → one ``device_object_fetch`` RPC to the source worker;
-      buffers travel device→host→(shm/socket, zero-copy pickle-5)→device.
-      This is the host-staging transport — the only possible one between two
-      single-host processes that own disjoint TPU chips.
-- Multi-host SPMD note: between hosts of one jax.distributed mesh, arrays are
-  *already* resident where the computation needs them, and movement compiles
-  into the program as ICI collectives (parallel/). The device-object plane is
-  for MPMD actor topologies (pipelines, serve replicas), where host staging
-  over DCN matches what the hardware offers. ``Communicator`` below is the
-  plugin surface for future out-of-band transports.
+  resolution picks the cheapest transport that physically applies:
+
+  1. same process            → the original jax.Array objects, zero copies;
+  2. same jax.distributed
+     transfer group          → ``MeshCollectiveCommunicator``: a one-shot
+     compiled shard_map/ppermute program over a sub-mesh of the source's and
+     the receiver's devices. The tensor bytes never touch the host: on TPU
+     they ride ICI, on the CPU backend the distributed runtime's transfer
+     layer. Both sides enter the same program (the receiver RPCs the source
+     to start its half), serialized group-wide by a GCS lease so concurrent
+     transfers cannot interleave collectives and deadlock;
+  3. same host, different
+     process                 → ``ShmStagingCommunicator``: the source DMAs
+     device→host straight into a /dev/shm segment, the receiver maps it and
+     device_puts each tensor from the view — no pickling of tensor bytes
+     and no socket copies;
+  4. anything else           → ``HostStagingCommunicator``: one RPC, raw
+     buffers on the wire via pickle-5 out-of-band frames.
+
+- Multi-host SPMD note: between hosts of one jax.distributed mesh running
+  SPMD programs, arrays are *already* resident where the computation needs
+  them and movement compiles into the program (parallel/). The device-object
+  plane is for MPMD actor topologies (pipelines, serve replicas, compiled
+  DAGs via ``with_tensor_transport``), where transport 2 is the TPU analog
+  of the reference's NCCL channels.
 
 Garbage collection: the object's owner (the caller, for actor-method results;
 the putting process, for device_put) already ref-counts the skeleton. When
@@ -44,9 +58,14 @@ fire-and-forget ``device_object_free`` to the source actor.
 from __future__ import annotations
 
 import abc
+import asyncio
+import functools
 import logging
+import os
 import pickle
 import threading
+import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -58,11 +77,61 @@ def _is_jax_array(value: Any) -> bool:
     return mod is not None and mod.startswith("jax")
 
 
+# ----------------------------------------------------------------------
+# Transfer accounting (the "staging-counter spy": tests assert which
+# transport carried the bytes)
+# ----------------------------------------------------------------------
+
+_stats_lock = threading.Lock()
+_stats: Dict[str, int] = {
+    "host_staging_fetches": 0,   # RPC fetches served/issued (socket bytes)
+    "shm_staging_fetches": 0,    # same-host /dev/shm stagings
+    "mesh_collective_fetches": 0,  # device-to-device collective transfers
+    "local_hits": 0,             # same-process resolutions (zero copies)
+}
+
+
+def _bump(key: str, n: int = 1) -> None:
+    with _stats_lock:
+        _stats[key] = _stats.get(key, 0) + n
+
+
+def transfer_stats() -> Dict[str, int]:
+    with _stats_lock:
+        return dict(_stats)
+
+
+def reset_transfer_stats() -> None:
+    with _stats_lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def _np_dtype(name: str):
+    """numpy dtype incl. the ml_dtypes extensions jax uses (bfloat16...)."""
+    import numpy as np
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 @dataclass
 class _TensorMeta:
     shape: Tuple[int, ...]
-    dtype: str  # numpy dtype string
+    dtype: str  # numpy/ml_dtypes dtype string
     sharding: str = ""  # informational (repr of the source sharding)
+    # Mesh-transfer layout (filled only when the source is in a transfer
+    # group and the array is fully addressable there):
+    src_device_ids: Tuple[int, ...] = ()   # global ids, mesh-flat order
+    shard_shape: Tuple[int, ...] = ()      # per-device shard shape
+    mesh_shape: Tuple[int, ...] = ()       # source mesh topology
+    axis_names: Tuple[str, ...] = ()
+    spec: Optional[Tuple[Any, ...]] = None  # PartitionSpec entries; None =
+    #                                         single-device array
 
 
 class _DeviceTensorRef:
@@ -87,6 +156,7 @@ class DeviceObjectValue:
     meta: List[_TensorMeta]
     src_address: Tuple[str, int]  # RPC address of the worker holding tensors
     object_id: bytes  # binary ObjectID the tensors are stored under
+    mesh_group: str = ""  # transfer group the source belongs to ("" = none)
 
 
 @dataclass
@@ -120,12 +190,42 @@ class DeviceObjectStore:
             return len(self._entries)
 
 
+# ----------------------------------------------------------------------
+# Transfer groups (reference: communicator group bootstrap in
+# util/collective + channel/communicator.py — here the group IS the
+# jax.distributed process set, so "join" is just recording membership)
+# ----------------------------------------------------------------------
+
+_transfer_group: str = ""
+
+
+def join_transfer_group(name: str) -> None:
+    """Mark this process as a member of transfer group `name`.
+
+    Precondition: jax.distributed is initialized across the group's
+    processes (e.g. by train's JaxBackend or an explicit
+    jax.distributed.initialize), so every member sees the same global
+    device list. Members exchange device objects via compiled collective
+    programs instead of host staging.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        raise RuntimeError(
+            "join_transfer_group requires jax.distributed to be "
+            "initialized across >1 process")
+    global _transfer_group
+    _transfer_group = name
+
+
+def current_transfer_group() -> str:
+    return _transfer_group
+
+
 class Communicator(abc.ABC):
     """Transport plugin surface (reference:
-    experimental/channel/communicator.py:18). The default, and on single-host
-    TPU topologies the only physically possible one, is host staging; an ICI
-    communicator for jax.distributed meshes would implement send/recv as
-    compiled ppermute steps."""
+    experimental/channel/communicator.py:18). fetch() runs on a non-loop
+    thread and returns the tensors of `value` materialized locally."""
 
     @abc.abstractmethod
     def fetch(self, worker, value: "DeviceObjectValue") -> List[Any]:
@@ -136,32 +236,124 @@ class HostStagingCommunicator(Communicator):
     """Device→host→(zero-copy wire)→device via one RPC to the source."""
 
     def fetch(self, worker, value: "DeviceObjectValue") -> List[Any]:
-        return worker.loop_thread.run(
-            _fetch_async(worker, value))
+        return worker.loop_thread.run(_fetch_async(worker, value))
 
 
-_communicator: Communicator = HostStagingCommunicator()
+class ShmStagingCommunicator(Communicator):
+    """Same-host: source stages device→host directly into /dev/shm; the
+    receiver maps the segment and device_puts each tensor from the view.
+    Tensor bytes cross exactly two memcpys (device→shm, shm→device) and
+    never a socket or a pickle."""
+
+    def fetch(self, worker, value: "DeviceObjectValue") -> List[Any]:
+        reply = worker.loop_thread.run(_shm_fetch_rpc(worker, value))
+        return _shm_load(value, reply)
 
 
-def set_communicator(comm: Communicator) -> None:
+class MeshCollectiveCommunicator(Communicator):
+    """Device-to-device over a compiled ppermute program spanning the
+    source's and receiver's devices of one jax.distributed mesh. The
+    receiver drives: it takes the group-wide transfer lease, RPCs the
+    source to run its half, and runs its own half concurrently; the
+    collective itself is the synchronization."""
+
+    def fetch(self, worker, value: "DeviceObjectValue") -> List[Any]:
+        return _mesh_fetch(worker, value)
+
+
+_communicator: Optional[Communicator] = None  # explicit override only
+
+
+def set_communicator(comm: Optional[Communicator]) -> None:
+    """Force one transport (tests/plugins). None restores auto-selection."""
     global _communicator
     _communicator = comm
+
+
+def _mesh_eligible(worker, value: DeviceObjectValue) -> bool:
+    if not value.mesh_group or value.mesh_group != _transfer_group:
+        return False
+    try:
+        import jax
+
+        local_ids = [d.id for d in jax.local_devices()]
+    except Exception:
+        return False
+    for m in value.meta:
+        if not m.src_device_ids:
+            return False  # layout probe declined (uneven/non-addressable)
+        if len(m.src_device_ids) > len(local_ids):
+            return False
+    return True
+
+
+def _select_communicator(worker, value: DeviceObjectValue) -> Communicator:
+    if _communicator is not None:
+        return _communicator
+    if value.meta and _mesh_eligible(worker, value):
+        return MeshCollectiveCommunicator()
+    if value.src_address[0] == worker.address[0]:
+        return ShmStagingCommunicator()
+    return HostStagingCommunicator()
 
 
 # ----------------------------------------------------------------------
 # Extraction (source side)
 # ----------------------------------------------------------------------
 
+def _pack_spec(spec) -> Tuple[Any, ...]:
+    out = []
+    for e in tuple(spec):
+        out.append(tuple(e) if isinstance(e, (list, tuple)) else e)
+    return tuple(out)
+
+
+def _layout_meta(arr, meta: _TensorMeta) -> None:
+    """Record the array's device/sharding layout for mesh transfer.
+    Only fully-addressable arrays qualify (the typical MPMD-actor case:
+    the whole array lives on this process's devices)."""
+    try:
+        from jax.sharding import NamedSharding
+
+        sh = getattr(arr, "sharding", None)
+        if sh is None or not getattr(sh, "is_fully_addressable", False):
+            return
+        if isinstance(sh, NamedSharding):
+            flat = list(sh.mesh.devices.flatten())
+            by_dev = {s.device.id: s for s in arr.addressable_shards}
+            shards = [by_dev[d.id] for d in flat]
+            shapes = {tuple(s.data.shape) for s in shards}
+            if len(shapes) != 1:
+                return  # uneven sharding: fall back to staging
+            meta.src_device_ids = tuple(d.id for d in flat)
+            meta.shard_shape = shapes.pop()
+            meta.mesh_shape = tuple(sh.mesh.devices.shape)
+            meta.axis_names = tuple(sh.mesh.axis_names)
+            meta.spec = _pack_spec(sh.spec)
+        else:
+            devs = list(getattr(sh, "_device_assignment", [])) or (
+                [arr.devices().pop()] if hasattr(arr, "devices") else [])
+            if len(devs) != 1:
+                return
+            meta.src_device_ids = (devs[0].id,)
+            meta.shard_shape = tuple(arr.shape)
+            meta.mesh_shape = (1,)
+            meta.axis_names = ()
+            meta.spec = None  # single-device array
+    except Exception:
+        logger.debug("layout probe failed", exc_info=True)
+
+
 def extract(value: Any) -> Tuple[bytes, List[Any], List[_TensorMeta]]:
     """Replace every jax.Array in `value` with a placeholder; return
     (pickled skeleton, arrays, meta). Uses a custom pickler so arbitrary
     containers work, not just registered pytrees."""
+    import io
+
     import cloudpickle
 
     arrays: List[Any] = []
     meta: List[_TensorMeta] = []
-
-    import io
 
     class _ExtractPickler(cloudpickle.Pickler):
         def persistent_id(self, obj):
@@ -170,9 +362,11 @@ def extract(value: Any) -> Tuple[bytes, List[Any], List[_TensorMeta]]:
                 arrays.append(obj)
                 import numpy as np
 
-                meta.append(_TensorMeta(
+                m = _TensorMeta(
                     tuple(obj.shape), str(np.dtype(obj.dtype)),
-                    repr(getattr(obj, "sharding", ""))))
+                    repr(getattr(obj, "sharding", "")))
+                _layout_meta(obj, m)
+                meta.append(m)
                 return ("device_tensor", idx)
             return None
 
@@ -201,7 +395,7 @@ def store_result(worker, object_id, value: Any) -> DeviceObjectValue:
     worker.device_object_store.add(object_id.binary(), arrays, meta)
     return DeviceObjectValue(
         skeleton=skeleton, meta=meta, src_address=tuple(worker.address),
-        object_id=object_id.binary())
+        object_id=object_id.binary(), mesh_group=_transfer_group)
 
 
 # ----------------------------------------------------------------------
@@ -220,7 +414,7 @@ def device_put(value: Any):
     w.device_object_store.add(object_id.binary(), arrays, meta)
     return w.put_with_id(object_id, DeviceObjectValue(
         skeleton=skeleton, meta=meta, src_address=tuple(w.address),
-        object_id=object_id.binary()))
+        object_id=object_id.binary(), mesh_group=_transfer_group))
 
 
 def local_store_size() -> int:
@@ -235,37 +429,58 @@ def local_store_size() -> int:
 
 def resolve_sync(worker, value: Any) -> Any:
     """If `value` is a device-object skeleton, materialize its tensors
-    locally (same-process: the original arrays; remote: one fetch RPC).
+    locally (same-process: the original arrays; remote: cheapest transport).
     Runs on a non-loop thread."""
     if not isinstance(value, DeviceObjectValue):
         return value
+    if not value.meta:
+        return _rebuild(value.skeleton, [])  # tensor-free skeleton
     entry = worker.device_object_store.get(value.object_id)
     if entry is not None:
+        _bump("local_hits")
         return _rebuild(value.skeleton, entry.arrays)
-    arrays = _communicator.fetch(worker, value)
+    arrays = _select_communicator(worker, value).fetch(worker, value)
     return _rebuild(value.skeleton, arrays)
 
 
 async def resolve_async(worker, value: Any) -> Any:
-    """Loop-side variant of resolve_sync."""
+    """Loop-side variant of resolve_sync: device work (DMA, collective
+    programs) runs in the default executor so the event loop stays live."""
     if not isinstance(value, DeviceObjectValue):
         return value
+    if not value.meta:
+        return _rebuild(value.skeleton, [])  # tensor-free skeleton
     entry = worker.device_object_store.get(value.object_id)
     if entry is not None:
+        _bump("local_hits")
         return _rebuild(value.skeleton, entry.arrays)
-    arrays = await _fetch_async(worker, value)
+    comm = _select_communicator(worker, value)
+    loop = asyncio.get_running_loop()
+    if isinstance(comm, HostStagingCommunicator):
+        arrays = await _fetch_async(worker, value)
+    elif isinstance(comm, ShmStagingCommunicator):
+        reply = await _shm_fetch_rpc(worker, value)
+        arrays = await loop.run_in_executor(None, _shm_load, value, reply)
+    else:
+        arrays = await loop.run_in_executor(None, comm.fetch, worker, value)
     return _rebuild(value.skeleton, arrays)
 
 
-async def _fetch_async(worker, value: DeviceObjectValue) -> List[Any]:
-    import numpy as np
+# ----------------------------------------------------------------------
+# Source RPC helper shared by every pull transport
+# ----------------------------------------------------------------------
 
+async def _call_source(src_address: Tuple[str, int], object_id: bytes,
+                       method: str, *, timeout: Optional[float] = None,
+                       **kwargs) -> Dict[str, Any]:
+    """One open→call→close round trip to the source worker; a reply with
+    "error" (object gone) becomes ObjectLostError."""
     from ray_tpu._private.rpc import RpcClient
 
-    client = RpcClient(*value.src_address, name="device-fetch")
+    client = RpcClient(*src_address, name=f"devobj-{method[-10:]}")
     try:
-        reply = await client.call(
-            "device_object_fetch", object_id=value.object_id)
+        reply = await client.call(method, object_id=object_id,
+                                  timeout=timeout, **kwargs)
     finally:
         try:
             await client.close()
@@ -275,12 +490,25 @@ async def _fetch_async(worker, value: DeviceObjectValue) -> List[Any]:
         from ray_tpu.exceptions import ObjectLostError
 
         raise ObjectLostError(
-            f"device object {value.object_id.hex()[:12]} no longer on "
-            f"source {value.src_address}: {reply['error']}")
+            f"device object {object_id.hex()[:12]} unavailable on "
+            f"source {src_address}: {reply['error']}")
+    return reply
+
+
+# ----------------------------------------------------------------------
+# Transport 4: RPC host staging
+# ----------------------------------------------------------------------
+
+async def _fetch_async(worker, value: DeviceObjectValue) -> List[Any]:
+    import numpy as np
+
+    _bump("host_staging_fetches")
+    reply = await _call_source(value.src_address, value.object_id,
+                               "device_object_fetch", timeout=300)
     bufs = reply["buffers"]
     out = []
     for m, buf in zip(value.meta, bufs):
-        host = np.frombuffer(buf, dtype=np.dtype(m.dtype)).reshape(m.shape)
+        host = np.frombuffer(buf, dtype=_np_dtype(m.dtype)).reshape(m.shape)
         out.append(_to_local_device(host))
     return out
 
@@ -289,6 +517,299 @@ def _to_local_device(host_array) -> Any:
     import jax
 
     return jax.device_put(host_array)
+
+
+# ----------------------------------------------------------------------
+# Transport 3: same-host /dev/shm staging
+# ----------------------------------------------------------------------
+
+async def _shm_fetch_rpc(worker, value: DeviceObjectValue) -> Dict[str, Any]:
+    # Staging a multi-GB object is a DMA + file write: give it well past
+    # the default RPC timeout.
+    return await _call_source(value.src_address, value.object_id,
+                              "device_object_fetch_shm", timeout=300)
+
+
+def _shm_load(value: DeviceObjectValue, reply: Dict[str, Any]) -> List[Any]:
+    """Map the staged segment and device_put each tensor from the view."""
+    import mmap
+
+    import numpy as np
+
+    _bump("shm_staging_fetches")
+    path = reply["path"]
+    sizes = reply["sizes"]
+    out: List[Any] = []
+    if not sizes or not sum(sizes):
+        # Zero tensor bytes staged (e.g. all-empty arrays): nothing to map.
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        import jax
+
+        return [jax.device_put(np.zeros(m.shape, dtype=_np_dtype(m.dtype)))
+                for m in value.meta]
+    try:
+        with open(path, "rb") as f:
+            # No explicit mm.close(): device_put may alias the mapping
+            # zero-copy (CPU backend), so the munmap must wait for the
+            # consuming arrays — the mapping dies with its last view.
+            mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+            off = 0
+            for m, size in zip(value.meta, sizes):
+                host = np.frombuffer(
+                    mm, dtype=_np_dtype(m.dtype),
+                    count=int(np.prod(m.shape, dtype=np.int64)),
+                    offset=off).reshape(m.shape)
+                out.append(_to_local_device(host))
+                off += size
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return out
+
+
+async def rpc_fetch_shm(worker, object_id: bytes) -> Dict[str, Any]:
+    """Source side: DMA tensors into a fresh /dev/shm segment, reply with
+    the path (the consumer unlinks it). Off-loop: multi-GB DMA must not
+    stall the source actor's RPC handling."""
+    entry = worker.device_object_store.get(object_id)
+    if entry is None:
+        return {"error": "not found"}
+
+    import numpy as np
+
+    def _stage():
+        path = os.path.join(
+            "/dev/shm", f"ray_tpu_devxfer_{uuid.uuid4().hex[:12]}")
+        sizes = []
+        with open(path, "wb") as f:
+            for a in entry.arrays:
+                host = np.asarray(a)  # device→host; view for CPU jax
+                if not host.flags.c_contiguous:
+                    host = np.ascontiguousarray(host)
+                f.write(memoryview(host).cast("B"))
+                sizes.append(host.nbytes)
+        return {"path": path, "sizes": sizes}
+
+    loop = asyncio.get_running_loop()
+    reply = await loop.run_in_executor(None, _stage)
+
+    def _cleanup(path=reply["path"]):
+        # Normally the consumer unlinked it long ago; this catches a
+        # consumer that timed out or died before mapping the segment, so
+        # repeated failures can't fill /dev/shm.
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    loop.call_later(300.0, _cleanup)
+    return reply
+
+
+# ----------------------------------------------------------------------
+# Transport 2: mesh-collective device-to-device
+# ----------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=256)
+def _transfer_program(src_ids: Tuple[int, ...], dst_ids: Tuple[int, ...],
+                      shard_shape: Tuple[int, ...], dtype: str):
+    """One-shot compiled send program: ppermute over a ("t",) mesh laid out
+    [src devices..., dst devices...]; slot i moves to slot n+i. Cached per
+    (device set, shape, dtype) — repeat transfers skip compilation."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    devmap = {d.id: d for d in jax.devices()}
+    devs = [devmap[i] for i in src_ids] + [devmap[i] for i in dst_ids]
+    n = len(src_ids)
+    tmesh = Mesh(np.array(devs), ("t",))
+    perm = [(i, n + i) for i in range(n)]
+
+    def _send(x):
+        return jax.lax.ppermute(x, "t", perm)
+
+    fn = jax.jit(jax.shard_map(_send, mesh=tmesh,
+                               in_specs=P("t"), out_specs=P("t")))
+    return fn, NamedSharding(tmesh, P("t")), tmesh
+
+
+def _mesh_send_one(shards: List[Any], src_ids: Tuple[int, ...],
+                   dst_ids: Tuple[int, ...], shard_shape: Tuple[int, ...],
+                   dtype: str) -> None:
+    """Source half: contribute data shards; discard the output."""
+    import jax
+
+    fn, sharding, _ = _transfer_program(src_ids, dst_ids,
+                                        tuple(shard_shape), dtype)
+    local = [s.reshape((1,) + tuple(shard_shape)) for s in shards]
+    gx = jax.make_array_from_single_device_arrays(
+        (2 * len(src_ids),) + tuple(shard_shape), sharding, local)
+    jax.block_until_ready(fn(gx))
+
+
+def _mesh_recv_one(meta: _TensorMeta, dst_ids: Tuple[int, ...]) -> Any:
+    """Receiver half: contribute zeros; collect its half of the output and
+    reassemble the logical array with the source's sharding topology mapped
+    onto local devices."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    src_ids = tuple(meta.src_device_ids)
+    shard_shape = tuple(meta.shard_shape)
+    fn, sharding, _ = _transfer_program(src_ids, dst_ids,
+                                        shard_shape, meta.dtype)
+    devmap = {d.id: d for d in jax.devices()}
+    zeros = np.zeros((1,) + shard_shape, dtype=_np_dtype(meta.dtype))
+    local = [jax.device_put(zeros, devmap[i]) for i in dst_ids]
+    gx = jax.make_array_from_single_device_arrays(
+        (2 * len(src_ids),) + shard_shape, sharding, local)
+    out = fn(gx)
+    by_dev = {s.device.id: s.data for s in out.addressable_shards}
+    dst_shards = [by_dev[i].reshape(shard_shape) for i in dst_ids]
+    if meta.spec is None:
+        return dst_shards[0]
+    devs = np.array([devmap[i] for i in dst_ids]).reshape(meta.mesh_shape)
+    mesh = Mesh(devs, meta.axis_names)
+    rebuilt_sharding = NamedSharding(mesh, P(*meta.spec))
+    return jax.make_array_from_single_device_arrays(
+        tuple(meta.shape), rebuilt_sharding, dst_shards)
+
+
+class _GroupLease:
+    """Group-wide transfer lease over GCS kv_cas: serializes transfers so
+    two pairs can never interleave collective programs (the A→B / B→A
+    deadlock). Crash-safe — a holder that dies is overtaken after ttl —
+    and live-safe: the holder refreshes its stamp from the worker loop, so
+    a long transfer (first-time jit compile + multi-GB collective) is
+    never overtaken mid-flight."""
+
+    TTL = 60.0
+
+    def __init__(self, worker, group: str):
+        self.worker = worker
+        self.key = f"devobj:xferlock:{group}"
+        self.value: Optional[bytes] = None
+        self._refresher: Optional[asyncio.Task] = None
+
+    async def acquire(self) -> None:
+        gcs = self.worker.gcs_client
+        while True:
+            cur = await gcs.call("kv_get", key=self.key)
+            stale = True
+            if cur:
+                try:
+                    _, stamp = pickle.loads(cur)
+                    stale = time.time() - stamp > self.TTL
+                except Exception:
+                    pass
+            if cur is None or stale:
+                mine = pickle.dumps((tuple(self.worker.address), time.time()))
+                if await gcs.call("kv_cas", key=self.key,
+                                  expect=cur, value=mine):
+                    self.value = mine
+                    self._refresher = asyncio.ensure_future(self._refresh())
+                    return
+            await asyncio.sleep(0.01)
+
+    async def _refresh(self) -> None:
+        gcs = self.worker.gcs_client
+        while True:
+            await asyncio.sleep(self.TTL / 3)
+            nxt = pickle.dumps((tuple(self.worker.address), time.time()))
+            if not await gcs.call("kv_cas", key=self.key,
+                                  expect=self.value, value=nxt):
+                return  # overtaken (should not happen while refreshing)
+            self.value = nxt
+
+    async def release(self) -> None:
+        if self._refresher is not None:
+            self._refresher.cancel()
+        if self.value is not None:
+            # CAS to a stale tombstone: only the current holder's lands.
+            await self.worker.gcs_client.call(
+                "kv_cas", key=self.key, expect=self.value,
+                value=pickle.dumps((None, 0.0)))
+
+
+def _mesh_fetch(worker, value: DeviceObjectValue) -> List[Any]:
+    """Receiver-driven collective transfer (runs on a non-loop thread).
+
+    Protocol: take the group lease → one RPC to the source, which VALIDATES
+    the object and replies "started" after scheduling its send half →
+    receiver runs its receive half; the collective itself synchronizes the
+    two halves. Validation-before-recv means a freed/lost object surfaces
+    as ObjectLostError instead of a receiver wedged in a collective no one
+    will join. (A source crash mid-send still relies on the collective
+    backend's own deadline to unwedge the receiver.)"""
+    import jax
+
+    _bump("mesh_collective_fetches")
+    local_ids = [d.id for d in jax.local_devices()]
+    per_tensor_dst = [tuple(local_ids[:len(m.src_device_ids)])
+                      for m in value.meta]
+    lease = _GroupLease(worker, value.mesh_group)
+    worker.loop_thread.run(lease.acquire())
+    try:
+        worker.loop_thread.run(
+            _mesh_send_rpc(worker, value, per_tensor_dst))  # raises if gone
+        return [_mesh_recv_one(m, dst)
+                for m, dst in zip(value.meta, per_tensor_dst)]
+    finally:
+        worker.loop_thread.run(lease.release())
+
+
+async def _mesh_send_rpc(worker, value: DeviceObjectValue,
+                         per_tensor_dst: List[Tuple[int, ...]]
+                         ) -> Dict[str, Any]:
+    return await _call_source(
+        value.src_address, value.object_id, "device_object_mesh_send",
+        timeout=30, dst_ids=[list(d) for d in per_tensor_dst])
+
+
+async def rpc_mesh_send(worker, object_id: bytes,
+                        dst_ids: List[List[int]]) -> Dict[str, Any]:
+    """Source side: validate, then run the send halves off-loop in the
+    BACKGROUND and reply "started" immediately — the receiver must hear
+    that validation passed before it enters its receive collectives.
+    Serialization across concurrent transfers comes from the receiver-held
+    group lease (one transfer at a time per group); a process in several
+    groups at once has no extra local guard and relies on its groups'
+    device sets being disjoint."""
+    entry = worker.device_object_store.get(object_id)
+    if entry is None:
+        return {"error": "not found"}
+
+    def _run():
+        for arr, m, dst in zip(entry.arrays, entry.meta, dst_ids):
+            _mesh_send_one(_shards_for(arr, m), tuple(m.src_device_ids),
+                           tuple(dst), tuple(m.shard_shape), m.dtype)
+
+    loop = asyncio.get_running_loop()
+
+    async def _send_bg():
+        try:
+            await loop.run_in_executor(None, _run)
+        except Exception:  # noqa: BLE001
+            # Receiver unwedges via the collective backend's own deadline.
+            logger.exception("mesh send failed mid-transfer")
+
+    asyncio.ensure_future(_send_bg())
+    return {"ok": True, "started": True}
+
+
+def _shards_for(arr, meta: _TensorMeta) -> List[Any]:
+    """The array's single-device shards in mesh-flat (meta) order."""
+    by_dev = {s.device.id: s.data for s in arr.addressable_shards}
+    return [by_dev[i] for i in meta.src_device_ids]
 
 
 # ----------------------------------------------------------------------
@@ -303,9 +824,9 @@ async def rpc_fetch(worker, object_id: bytes) -> Dict[str, Any]:
     entry = worker.device_object_store.get(object_id)
     if entry is None:
         return {"error": "not found"}
-    import asyncio
-
     import numpy as np
+
+    _bump("host_staging_fetches")
 
     def _stage():
         bufs = []

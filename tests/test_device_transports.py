@@ -1,0 +1,185 @@
+"""Device-object transports: same-host shm staging and mesh-collective
+device-to-device (reference: gpu_object_manager + aDAG NCCL channels,
+experimental/channel/torch_tensor_nccl_channel.py — here the accelerator
+transport is a compiled ppermute program over a jax.distributed mesh).
+
+The staging-counter spy (devobj.transfer_stats) asserts WHICH transport
+carried the tensor bytes: the mesh tests require zero host/shm stagings.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import device_objects as devobj
+
+
+def test_same_host_fetch_uses_shm_staging(ray_start_regular):
+    @ray_tpu.remote
+    class Producer:
+        def make(self, n):
+            import jax.numpy as jnp
+
+            return {"w": jnp.arange(float(n))}
+
+    @ray_tpu.remote
+    class Consumer:
+        def use_and_stats(self, payload):
+            from ray_tpu.experimental import device_objects as d
+
+            # the fetch was counted during arg deserialization, in this
+            # same process, before the method body ran
+            return float(payload["w"].sum()), d.transfer_stats()
+
+    p, c = Producer.remote(), Consumer.remote()
+    ref = p.make.options(tensor_transport="device").remote(64)
+    total, stats = ray_tpu.get(c.use_and_stats.remote(ref))
+    assert total == float(np.arange(64.0).sum())
+    # Same host, different process: the bytes crossed /dev/shm, not a
+    # socket.
+    assert stats["shm_staging_fetches"] == 1, stats
+    assert stats["host_staging_fetches"] == 0, stats
+
+
+@pytest.fixture(scope="module")
+def mesh_peers(ray_cluster):
+    """Two actor processes joined into one jax.distributed CPU mesh
+    (2 procs x 8 virtual devices) and the 'xfer' transfer group."""
+    from ray_tpu._private.node import free_port
+
+    @ray_tpu.remote
+    class Peer:
+        def __init__(self, rank, world, coord):
+            self.rank, self.world, self.coord = rank, world, coord
+
+        def join(self):
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=self.coord, num_processes=self.world,
+                process_id=self.rank)
+            from ray_tpu.experimental import device_objects as d
+
+            d.join_transfer_group("xfer")
+            return (jax.process_count(), jax.local_device_count())
+
+        def produce_sharded(self, n):
+            import jax
+            import jax.numpy as jnp
+            import numpy as onp
+            from jax.sharding import Mesh, NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            mesh = Mesh(onp.array(jax.local_devices()), ("d",))
+            arr = jax.device_put(
+                jnp.arange(float(n * 8)).reshape(8, n),
+                NamedSharding(mesh, P("d")))
+            return {"x": arr, "tag": n}
+
+        def produce_single(self, n):
+            import jax.numpy as jnp
+
+            return jnp.ones((n,), jnp.float32) * 3.0
+
+        def consume(self, payload):
+            from ray_tpu.experimental import device_objects as d
+
+            x = payload["x"]
+            return {
+                "sum": float(x.sum()),
+                "tag": payload["tag"],
+                "sharding": type(x.sharding).__name__,
+                "ndev": len(x.sharding.device_set),
+                "stats": d.transfer_stats(),
+            }
+
+        def consume_single(self, x):
+            from ray_tpu.experimental import device_objects as d
+
+            return float(x.sum()), d.transfer_stats()
+
+        def reset_stats(self):
+            from ray_tpu.experimental import device_objects as d
+
+            d.reset_transfer_stats()
+
+        def drop_all_device_objects(self):
+            from ray_tpu._private import worker as wm
+
+            st = wm.global_worker().device_object_store
+            with st._lock:
+                st._entries.clear()
+
+        def stats(self):
+            from ray_tpu.experimental import device_objects as d
+
+            return d.transfer_stats()
+
+    coord = f"127.0.0.1:{free_port()}"
+    a = Peer.remote(0, 2, coord)
+    b = Peer.remote(1, 2, coord)
+    # initialize blocks until both dial: submit both before getting
+    ja, jb = a.join.remote(), b.join.remote()
+    assert ray_tpu.get(ja, timeout=120) == (2, 8)
+    assert ray_tpu.get(jb, timeout=120) == (2, 8)
+    return a, b
+
+
+def test_mesh_collective_sharded_transfer(ray_start_regular, mesh_peers):
+    a, b = mesh_peers
+    ray_tpu.get([a.reset_stats.remote(), b.reset_stats.remote()])
+    ref = a.produce_sharded.options(tensor_transport="device").remote(8)
+    out = ray_tpu.get(b.consume.remote(ref), timeout=180)
+    assert out["sum"] == float(np.arange(64.0).sum())
+    assert out["tag"] == 8
+    # arrived SHARDED across the receiver's 8 devices, not host-staged
+    assert out["sharding"] == "NamedSharding"
+    assert out["ndev"] == 8
+    assert out["stats"]["mesh_collective_fetches"] == 1, out["stats"]
+    assert out["stats"]["host_staging_fetches"] == 0, out["stats"]
+    assert out["stats"]["shm_staging_fetches"] == 0, out["stats"]
+    # source never served a staging RPC either
+    src_stats = ray_tpu.get(a.stats.remote())
+    assert src_stats["host_staging_fetches"] == 0, src_stats
+    assert src_stats["shm_staging_fetches"] == 0, src_stats
+
+
+def test_mesh_collective_single_device_tensor(ray_start_regular, mesh_peers):
+    a, b = mesh_peers
+    ray_tpu.get([a.reset_stats.remote(), b.reset_stats.remote()])
+    ref = b.produce_single.options(tensor_transport="device").remote(32)
+    total, stats = ray_tpu.get(a.consume_single.remote(ref), timeout=180)
+    assert total == 96.0
+    assert stats["mesh_collective_fetches"] == 1, stats
+    assert stats["host_staging_fetches"] == 0, stats
+
+
+def test_mesh_fetch_of_freed_object_raises(ray_start_regular, mesh_peers):
+    """Source validation happens BEFORE the receiver enters its receive
+    collectives: a freed object must surface as an error, not wedge the
+    receiver in a collective nobody will join."""
+    a, b = mesh_peers
+    ref = a.produce_sharded.options(tensor_transport="device").remote(8)
+    ray_tpu.get(a.drop_all_device_objects.remote())
+    with pytest.raises(Exception, match="unavailable|ObjectLost"):
+        ray_tpu.get(b.consume.remote(ref), timeout=60)
+
+
+def test_dag_tensor_transport_device_to_device(ray_start_regular, mesh_peers):
+    """2-stage compiled DAG moving a sharded array producer→consumer with
+    zero host staging (reference: aDAG with_tensor_transport + NCCL
+    channels)."""
+    from ray_tpu.dag import InputNode
+
+    a, b = mesh_peers
+    ray_tpu.get([a.reset_stats.remote(), b.reset_stats.remote()])
+    with InputNode() as inp:
+        node = b.consume.bind(
+            a.produce_sharded.bind(inp).with_tensor_transport("device"))
+    dag = node.experimental_compile()
+    out = ray_tpu.get(dag.execute(8), timeout=180)
+    assert out["sum"] == float(np.arange(64.0).sum())
+    assert out["stats"]["mesh_collective_fetches"] >= 1, out["stats"]
+    assert out["stats"]["host_staging_fetches"] == 0, out["stats"]
+    assert out["stats"]["shm_staging_fetches"] == 0, out["stats"]
+    dag.teardown()
