@@ -10,9 +10,14 @@ per content hash) and returns the env-var deltas for the worker spawn."""
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import io
+import json
 import os
+import shutil
+import subprocess
+import sys
 import zipfile
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -109,4 +114,108 @@ async def materialize(runtime_env: Optional[Dict[str, Any]],
             pythonpath_add.append(path)
     if pythonpath_add:
         env["RAY_TPU_PYTHONPATH_PREPEND"] = os.pathsep.join(pythonpath_add)
+    pip_spec = runtime_env.get("pip") or runtime_env.get("uv")
+    if pip_spec:
+        loop = asyncio.get_running_loop()
+        py = await loop.run_in_executor(
+            None, ensure_pip_venv, pip_spec,
+            os.path.join(base_dir, "venvs"))
+        env["RAY_TPU_PYTHON_EXECUTABLE"] = py
     return env
+
+
+# ----------------------------------------------------------------------
+# pip/uv isolated environments (reference: _private/runtime_env/uv.py,
+# pip.py — per-env-hash venvs, cached per node, workers launched with the
+# venv's interpreter)
+# ----------------------------------------------------------------------
+
+def normalize_pip_spec(spec: Any) -> Tuple[List[str], List[str]]:
+    """`pip`/`uv` accepts a list of requirement strings or
+    {"packages": [...], "pip_install_options"/"options": [...]}."""
+    if isinstance(spec, (list, tuple)):
+        return [str(s) for s in spec], []
+    if isinstance(spec, dict):
+        pkgs = [str(s) for s in (spec.get("packages") or [])]
+        opts = [str(s) for s in (spec.get("pip_install_options")
+                                 or spec.get("options") or [])]
+        return pkgs, opts
+    raise ValueError(f"invalid pip runtime_env spec: {spec!r}")
+
+
+def pip_env_hash(spec: Any) -> str:
+    pkgs, opts = normalize_pip_spec(spec)
+    blob = json.dumps({"p": sorted(pkgs), "o": opts,
+                       "py": sys.version_info[:2]}, sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+def ensure_pip_venv(spec: Any, venvs_dir: str) -> str:
+    """Build (once per content hash per node) a venv with the requested
+    packages installed and return its python executable. Safe under
+    concurrent worker spawns: an flock serializes builders, and a marker
+    file makes completed builds reusable without the lock. The venv
+    inherits the base interpreter's site-packages (--system-site-packages)
+    so jax & friends stay importable — per-env packages OVERRIDE them via
+    sys.path precedence, matching the reference's inherit-and-extend uv
+    behavior."""
+    import fcntl
+
+    pkgs, opts = normalize_pip_spec(spec)
+    digest = pip_env_hash(spec)
+    dest = os.path.join(venvs_dir, digest)
+    py = os.path.join(dest, "bin", "python")
+    marker = os.path.join(dest, ".ray_tpu_env_ok")
+    if os.path.exists(marker):
+        return py
+    os.makedirs(venvs_dir, exist_ok=True)
+    lock_path = os.path.join(venvs_dir, f".{digest}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):  # built while we waited
+                return py
+            if os.path.isdir(dest):
+                shutil.rmtree(dest)  # half-built leftover from a crash
+            _run([sys.executable, "-m", "venv",
+                  "--system-site-packages", dest])
+            # When the base interpreter is ITSELF a venv (common: /opt/venv),
+            # --system-site-packages chains to the SYSTEM site, not the
+            # base venv's — chain explicitly via a .pth so jax & the
+            # runtime's deps stay importable. Venv-local site-packages stay
+            # earlier on sys.path, so per-env packages still override.
+            import site
+
+            venv_site = os.path.join(
+                dest, "lib",
+                f"python{sys.version_info[0]}.{sys.version_info[1]}",
+                "site-packages")
+            parents = [p for p in site.getsitepackages()
+                       if os.path.isdir(p) and not p.startswith(dest)]
+            if parents:
+                with open(os.path.join(venv_site,
+                                       "_ray_tpu_parent.pth"), "w") as f:
+                    f.write("\n".join(parents) + "\n")
+            if pkgs:
+                uv = shutil.which("uv")
+                if uv:
+                    _run([uv, "pip", "install", "--python", py,
+                          *opts, *pkgs])
+                else:
+                    _run([py, "-m", "pip", "install",
+                          "--disable-pip-version-check", *opts, *pkgs])
+            with open(marker, "w") as f:
+                f.write(json.dumps({"packages": pkgs, "options": opts}))
+            logger.info("runtime env venv %s ready (%d packages)",
+                        digest, len(pkgs))
+            return py
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def _run(cmd: List[str]) -> None:
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=600)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"runtime env command {' '.join(cmd[:3])}… failed "
+            f"(rc={proc.returncode}): {proc.stderr[-800:]}")

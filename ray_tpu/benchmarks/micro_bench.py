@@ -74,6 +74,22 @@ def _timeit(name: str, fn: Callable[[], None], multiplier: float = 1,
     }
 
 
+# Metrics whose baseline was recorded on 64-core release infra and whose
+# value here is floored by the 1-core host (parallel sleeps / true
+# multi-process parallelism), not by the runtime's efficiency.
+HOST_FLOORED = {
+    "multi_client_tasks_async": "N caller actors share one physical core",
+    "multi_client_put_gigabytes": "4 concurrent 50MiB memcpys on one core",
+    "n_n_actor_calls_async": "caller actors share one physical core",
+    "1_n_actor_calls_async":
+        "N callee actor processes time-slice one core with the caller",
+    "1_n_async_actor_calls_async":
+        "N callee actor processes time-slice one core with the caller",
+    "single_client_wait_1k_refs":
+        "1000 x 0.1s sleeps need parallel workers (64-core baseline infra)",
+}
+
+
 def run_micro_benchmarks(ray_tpu, *, n_actors: int = 4,
                          include_client: bool = True,
                          progress: Optional[Callable[[str], None]] = None,
@@ -83,11 +99,24 @@ def run_micro_benchmarks(ray_tpu, *, n_actors: int = 4,
     results: List[Dict[str, Any]] = []
 
     def emit(r):
+        if r["name"] in HOST_FLOORED:
+            r["host_floored"] = HOST_FLOORED[r["name"]]
         results.append(r)
         if progress:
             vs = r["vs_baseline"]
             progress(f"{r['name']}: {r['value']} {r['unit']}"
                      + (f" ({vs}x baseline)" if vs else ""))
+
+    def retire(*handles):
+        """Kill a bench family's actor fleet: idle actor processes steal
+        cycles from every later measurement on a 1-core host."""
+        for h in handles:
+            for a in (h if isinstance(h, list) else [h]):
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        time.sleep(0.3)
 
     @ray_tpu.remote
     class Actor:
@@ -123,65 +152,7 @@ def run_micro_benchmarks(ray_tpu, *, n_actors: int = 4,
     def small_value():
         return b"ok"
 
-    # ---- tasks ---------------------------------------------------------
-    ray_tpu.get(small_value.remote())
-    emit(_timeit("single_client_tasks_sync",
-                 lambda: ray_tpu.get(small_value.remote())))
-    emit(_timeit(
-        "single_client_tasks_async",
-        lambda: ray_tpu.get([small_value.remote() for _ in range(1000)]),
-        1000))
-
-    batchers = [Actor.remote() for _ in range(n_actors)]
-    ray_tpu.get([a.small_value.remote() for a in batchers])
-    emit(_timeit(
-        "multi_client_tasks_async",
-        lambda: ray_tpu.get(
-            [a.small_value_batch.remote(250) for a in batchers]),
-        250 * n_actors))
-
-    def tasks_and_get_batch():
-        ray_tpu.get([small_value.remote() for _ in range(1000)])
-
-    emit(_timeit("single_client_tasks_and_get_batch", tasks_and_get_batch))
-
-    # ---- object plane --------------------------------------------------
-    ref = ray_tpu.put(b"small")
-    emit(_timeit("single_client_get_calls",
-                 lambda: ray_tpu.get(ref)))
-    emit(_timeit("single_client_put_calls",
-                 lambda: ray_tpu.put(b"small")))
-    emit(_timeit(
-        "multi_client_put_calls",
-        lambda: ray_tpu.get([a.put_batch.remote(250) for a in batchers]),
-        250 * n_actors))
-
-    big = np.zeros(100 * 1024 * 1024, dtype=np.uint8)
-    emit(_timeit("single_client_put_gigabytes",
-                 lambda: ray_tpu.put(big), 100 / 1024, target_s=2.0))
-    emit(_timeit(
-        "multi_client_put_gigabytes",
-        lambda: ray_tpu.get([a.put_large.remote(50) for a in batchers]),
-        50 * n_actors / 1024, target_s=2.0))
-
-    refs_10k = ray_tpu.put([ray_tpu.put(b"x") for _ in range(10_000)])
-    emit(_timeit("single_client_get_object_containing_10k_refs",
-                 lambda: ray_tpu.get(refs_10k)))
-
-    @ray_tpu.remote
-    def slow_value():
-        time.sleep(0.1)
-        return b"ok"
-
-    def wait_1k():
-        not_ready = [slow_value.remote() for _ in range(1000)]
-        while not_ready:
-            ready, not_ready = ray_tpu.wait(not_ready, num_returns=10)
-
-    emit(_timeit("single_client_wait_1k_refs", wait_1k, target_s=0.5,
-                 rounds=1))
-
-    # ---- actor calls ---------------------------------------------------
+    # ---- 1:1 actor calls (cleanest cluster state: measure these FIRST) -
     a = Actor.remote()
     ray_tpu.get(a.small_value.remote())
     emit(_timeit("1_1_actor_calls_sync",
@@ -196,25 +167,8 @@ def run_micro_benchmarks(ray_tpu, *, n_actors: int = 4,
         "1_1_actor_calls_concurrent",
         lambda: ray_tpu.get([conc.small_value.remote() for _ in range(1000)]),
         1000))
+    retire(a, conc)
 
-    pool = [Actor.remote() for _ in range(n_actors)]
-    ray_tpu.get([p.small_value.remote() for p in pool])
-    n = 1000
-    emit(_timeit(
-        "1_n_actor_calls_async",
-        lambda: ray_tpu.get(
-            [pool[i % n_actors].small_value.remote() for i in range(n)]),
-        n))
-
-    caller_pool = [Actor.remote() for _ in range(n_actors)]
-    ray_tpu.get([c.small_value.remote() for c in caller_pool])
-    emit(_timeit(
-        "n_n_actor_calls_async",
-        lambda: ray_tpu.get(
-            [c.actor_call_batch.remote(pool, 250) for c in caller_pool]),
-        250 * n_actors))
-
-    # ---- async actors --------------------------------------------------
     aa = AsyncActor.remote()
     ray_tpu.get(aa.small_value.remote())
     emit(_timeit("1_1_async_actor_calls_sync",
@@ -228,6 +182,69 @@ def run_micro_benchmarks(ray_tpu, *, n_actors: int = 4,
         lambda: ray_tpu.get(
             [aa.small_value_with_arg.remote(i) for i in range(1000)]),
         1000))
+    retire(aa)
+
+    # ---- tasks ---------------------------------------------------------
+    ray_tpu.get(small_value.remote())
+    emit(_timeit("single_client_tasks_sync",
+                 lambda: ray_tpu.get(small_value.remote())))
+    emit(_timeit(
+        "single_client_tasks_async",
+        lambda: ray_tpu.get([small_value.remote() for _ in range(1000)]),
+        1000))
+
+    def tasks_and_get_batch():
+        ray_tpu.get([small_value.remote() for _ in range(1000)])
+
+    emit(_timeit("single_client_tasks_and_get_batch", tasks_and_get_batch))
+
+    # ---- object plane --------------------------------------------------
+    ref = ray_tpu.put(b"small")
+    emit(_timeit("single_client_get_calls",
+                 lambda: ray_tpu.get(ref)))
+    emit(_timeit("single_client_put_calls",
+                 lambda: ray_tpu.put(b"small")))
+    big = np.zeros(100 * 1024 * 1024, dtype=np.uint8)
+    emit(_timeit("single_client_put_gigabytes",
+                 lambda: ray_tpu.put(big), 100 / 1024, target_s=2.0))
+    del big
+    refs_10k = ray_tpu.put([ray_tpu.put(b"x") for _ in range(10_000)])
+    emit(_timeit("single_client_get_object_containing_10k_refs",
+                 lambda: ray_tpu.get(refs_10k)))
+    del refs_10k
+
+    # ---- fan-out families (caller fleets; host-floored on 1 core) ------
+    batchers = [Actor.remote() for _ in range(n_actors)]
+    ray_tpu.get([b.small_value.remote() for b in batchers])
+    emit(_timeit(
+        "multi_client_tasks_async",
+        lambda: ray_tpu.get(
+            [b.small_value_batch.remote(250) for b in batchers]),
+        250 * n_actors))
+    emit(_timeit(
+        "multi_client_put_calls",
+        lambda: ray_tpu.get([b.put_batch.remote(250) for b in batchers]),
+        250 * n_actors))
+    emit(_timeit(
+        "multi_client_put_gigabytes",
+        lambda: ray_tpu.get([b.put_large.remote(50) for b in batchers]),
+        50 * n_actors / 1024, target_s=2.0))
+
+    pool = [Actor.remote() for _ in range(n_actors)]
+    ray_tpu.get([p.small_value.remote() for p in pool])
+    n = 1000
+    emit(_timeit(
+        "1_n_actor_calls_async",
+        lambda: ray_tpu.get(
+            [pool[i % n_actors].small_value.remote() for i in range(n)]),
+        n))
+    emit(_timeit(
+        "n_n_actor_calls_async",
+        lambda: ray_tpu.get(
+            [b.actor_call_batch.remote(pool, 250) for b in batchers]),
+        250 * n_actors))
+    retire(batchers, pool)
+
     apool = [AsyncActor.remote() for _ in range(n_actors)]
     ray_tpu.get([p.small_value.remote() for p in apool])
     emit(_timeit(
@@ -235,6 +252,20 @@ def run_micro_benchmarks(ray_tpu, *, n_actors: int = 4,
         lambda: ray_tpu.get(
             [apool[i % n_actors].small_value.remote() for i in range(n)]),
         n))
+    retire(apool)
+
+    @ray_tpu.remote
+    def slow_value():
+        time.sleep(0.1)
+        return b"ok"
+
+    def wait_1k():
+        not_ready = [slow_value.remote() for _ in range(1000)]
+        while not_ready:
+            ready, not_ready = ray_tpu.wait(not_ready, num_returns=10)
+
+    emit(_timeit("single_client_wait_1k_refs", wait_1k, target_s=0.5,
+                 rounds=1))
 
     # ---- placement groups ---------------------------------------------
     from ray_tpu.util.placement_group import (

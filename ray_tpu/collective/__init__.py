@@ -6,6 +6,10 @@ from ray_tpu.collective.collective import (
     broadcast,
     get_group,
     init_collective_group,
+    recv,
+    reduce,
+    reducescatter,
+    send,
 )
 
 __all__ = [
@@ -16,4 +20,8 @@ __all__ = [
     "broadcast",
     "get_group",
     "init_collective_group",
+    "recv",
+    "reduce",
+    "reducescatter",
+    "send",
 ]

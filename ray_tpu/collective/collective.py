@@ -68,20 +68,40 @@ class _Coordinator:
             out = arrs[0].copy()
             for a in arrs[1:]:
                 out = out + a
-            return out
-        if op == "max":
-            return np.maximum.reduce(arrs)
-        if op == "min":
-            return np.minimum.reduce(arrs)
-        if op == "mean":
+        elif op == "max":
+            out = np.maximum.reduce(arrs)
+        elif op == "min":
+            out = np.minimum.reduce(arrs)
+        elif op == "mean":
             out = arrs[0].copy()
             for a in arrs[1:]:
                 out = out + a
-            return out / len(arrs)
-        raise ValueError(f"unknown reduce op {op!r}")
+            out = out / len(arrs)
+        else:
+            raise ValueError(f"unknown reduce op {op!r}")
+        if kind == "reducescatter":
+            # Rank r's output is the r-th slice of the reduction along
+            # axis 0 (reference: reducescatter, collective.py:431).
+            return list(np.array_split(out, self.world_size))
+        return out
 
     def collect(self, round_key: str) -> Any:
         return self.results.get(round_key, _PENDING)
+
+    def collect_part(self, round_key: str, rank: int) -> Any:
+        """Per-rank slice of a reducescatter round."""
+        parts = self.results.get(round_key, _PENDING)
+        if isinstance(parts, str) and parts == _PENDING:
+            return _PENDING
+        return parts[rank]
+
+    # -- point-to-point (reference: collective.py send:560/recv:610) -----
+    def put_p2p(self, key: str, value: Any) -> None:
+        self.results[key] = value
+
+    def take_p2p(self, key: str) -> Any:
+        """Destructive read: a message is consumed by exactly one recv."""
+        return self.results.pop(key, _PENDING)
 
     def gc(self, before_round: str) -> None:
         for k in [k for k in self.results if k < before_round]:
@@ -89,6 +109,17 @@ class _Coordinator:
 
 
 _PENDING = "__ray_tpu_collective_pending__"
+
+
+class _DeviceEnvelope:
+    """Marks a p2p payload that rides the device-object plane: the inner
+    ObjectRef resolves on the receiver via the cheapest transport
+    (mesh-collective / shm staging — experimental/device_objects.py)."""
+
+    __slots__ = ("ref",)
+
+    def __init__(self, ref):
+        self.ref = ref
 
 
 class CollectiveGroup:
@@ -99,19 +130,18 @@ class CollectiveGroup:
         self.rank = rank
         self._coord = coordinator
         self._round = 0
+        self._p2p_seq: Dict[tuple, int] = {}
 
     def _next_key(self, kind: str) -> str:
         self._round += 1
         return f"{kind}:{self._round:012d}"
 
-    def _run_round(self, kind: str, value: Any, op: str = "sum",
-                   timeout: Optional[float] = 300.0) -> Any:
-        key = self._next_key(kind)
-        ray_tpu.get(self._coord.contribute.remote(key, self.rank, value, op))
+    def _poll(self, call, kind: str, key: str,
+              timeout: Optional[float]) -> Any:
         deadline = None if timeout is None else time.monotonic() + timeout
         delay = 0.001
         while True:
-            result = ray_tpu.get(self._coord.collect.remote(key))
+            result = ray_tpu.get(call())
             if not (isinstance(result, str) and result == _PENDING):
                 return result
             if deadline is not None and time.monotonic() > deadline:
@@ -121,11 +151,40 @@ class CollectiveGroup:
             time.sleep(delay)
             delay = min(delay * 2, 0.05)
 
-    # -- API (reference: collective.py allreduce:295, broadcast, allgather,
-    #    barrier, reduce) --
+    def _run_round(self, kind: str, value: Any, op: str = "sum",
+                   timeout: Optional[float] = 300.0) -> Any:
+        key = self._next_key(kind)
+        ray_tpu.get(self._coord.contribute.remote(key, self.rank, value, op))
+        return self._poll(lambda: self._coord.collect.remote(key),
+                          kind, key, timeout)
+
+    # -- API (reference: collective.py allreduce:295, reduce:358,
+    #    broadcast:391, allgather:425, reducescatter:431, send:560,
+    #    recv:610, barrier) --
 
     def allreduce(self, value, op: str = "sum"):
         return self._run_round("allreduce", value, op)
+
+    def reduce(self, value, dst_rank: int = 0, op: str = "sum",
+               timeout: Optional[float] = 300.0):
+        """Reduction delivered to dst_rank only; other ranks contribute and
+        return None without waiting for the result."""
+        key = self._next_key("reduce")
+        ray_tpu.get(self._coord.contribute.remote(key, self.rank, value, op))
+        if self.rank != dst_rank:
+            return None
+        return self._poll(lambda: self._coord.collect.remote(key),
+                          "reduce", key, timeout)
+
+    def reducescatter(self, value, op: str = "sum",
+                      timeout: Optional[float] = 300.0):
+        """Element-wise reduction of every rank's tensor, split along axis
+        0: rank r receives the r-th slice."""
+        key = self._next_key("reducescatter")
+        ray_tpu.get(self._coord.contribute.remote(key, self.rank, value, op))
+        return self._poll(
+            lambda: self._coord.collect_part.remote(key, self.rank),
+            "reducescatter", key, timeout)
 
     def allgather(self, value) -> List[Any]:
         return self._run_round("allgather", value)
@@ -136,6 +195,34 @@ class CollectiveGroup:
 
     def barrier(self) -> None:
         self._run_round("barrier", True)
+
+    # -- point-to-point --------------------------------------------------
+    def _p2p_key(self, src: int, dst: int) -> str:
+        seq = self._p2p_seq.get((src, dst), 0) + 1
+        self._p2p_seq[(src, dst)] = seq
+        return f"p2p:{src}:{dst}:{seq:012d}"
+
+    def send(self, value, dst_rank: int) -> None:
+        """Deliver `value` to exactly one recv(src_rank=me) on dst_rank.
+        Matching is by per-(src,dst) sequence number, so both sides must
+        issue their sends/recvs for a peer in the same order. jax.Arrays
+        ride the device-object plane: tensor bytes move source→receiver
+        via the cheapest transport (ICI mesh collective / shm), not
+        through the coordinator."""
+        key = self._p2p_key(self.rank, dst_rank)
+        from ray_tpu.experimental import device_objects as devobj
+
+        if devobj._is_jax_array(value):
+            value = _DeviceEnvelope(devobj.device_put(value))
+        ray_tpu.get(self._coord.put_p2p.remote(key, value))
+
+    def recv(self, src_rank: int, timeout: Optional[float] = 300.0):
+        key = self._p2p_key(src_rank, self.rank)
+        out = self._poll(lambda: self._coord.take_p2p.remote(key),
+                         "recv", key, timeout)
+        if isinstance(out, _DeviceEnvelope):
+            out = ray_tpu.get(out.ref)
+        return out
 
 
 def init_collective_group(
@@ -181,6 +268,24 @@ def get_group(group_name: str = "default") -> Optional[CollectiveGroup]:
 
 def allreduce(value, op: str = "sum", group_name: str = "default"):
     return _require(group_name).allreduce(value, op)
+
+
+def reduce(value, dst_rank: int = 0, op: str = "sum",
+           group_name: str = "default"):
+    return _require(group_name).reduce(value, dst_rank, op)
+
+
+def reducescatter(value, op: str = "sum", group_name: str = "default"):
+    return _require(group_name).reducescatter(value, op)
+
+
+def send(value, dst_rank: int, group_name: str = "default"):
+    return _require(group_name).send(value, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default",
+         timeout: Optional[float] = 300.0):
+    return _require(group_name).recv(src_rank, timeout)
 
 
 def allgather(value, group_name: str = "default"):

@@ -359,8 +359,11 @@ class Nodelet:
         log_dir = self._worker_log_dir
         os.makedirs(log_dir, exist_ok=True)
         out = open(os.path.join(log_dir, f"worker-{worker_id.hex()[:8]}.log"), "wb")
+        # pip/uv runtime envs run the worker under their venv's interpreter
+        # (reference: runtime_env/pip.py py_executable override).
+        python = env.pop("RAY_TPU_PYTHON_EXECUTABLE", sys.executable)
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main"],
+            [python, "-m", "ray_tpu._private.worker_main"],
             env=env, stdout=out, stderr=subprocess.STDOUT,
             start_new_session=True,
         )
@@ -395,7 +398,9 @@ class Nodelet:
                 return w
         env_updates: Dict[str, str] = {}
         if runtime_env and (runtime_env.get("working_dir")
-                            or runtime_env.get("py_modules")):
+                            or runtime_env.get("py_modules")
+                            or runtime_env.get("pip")
+                            or runtime_env.get("uv")):
             from ray_tpu._private.runtime_env import materialize
 
             env_updates = await materialize(

@@ -635,6 +635,108 @@ class Dataset:
 
         return Dataset([_RefSource(run_shuffle, name="HashShuffle")])
 
+    def join(self, other: "Dataset", on: str, *, how: str = "inner",
+             num_partitions: int = 8) -> "Dataset":
+        """Distributed hash join (reference: _internal/execution/operators/
+        join.py — hash-shuffle both sides by key, then per-partition joins).
+        Both sides are partitioned with the same stable hash, so partition p
+        of the left can only match partition p of the right; the P join
+        tasks run cluster-side and the driver only routes refs — payload
+        columns never materialize on the driver."""
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join how={how!r}")
+        left = self.hash_shuffle(on, num_partitions)
+        right = other.hash_shuffle(on, num_partitions)
+
+        def run_join() -> List[Any]:
+            lrefs = list(_exec_stream(list(left._plan)))
+            rrefs = list(_exec_stream(list(right._plan)))
+
+            @ray_tpu.remote
+            def _cols(b: Block):
+                return list(b.keys())
+
+            # Schema hints (column NAMES only — no payload): an empty
+            # partition on one side must still produce the full merged
+            # schema, or downstream block_concat sees inconsistent blocks.
+            def side_cols(refs) -> List[str]:
+                for cols in ray_tpu.get([_cols.remote(r) for r in refs]):
+                    if cols:
+                        return cols
+                return [on]
+
+            lcols, rcols = side_cols(lrefs), side_cols(rrefs)
+
+            @ray_tpu.remote
+            def _join_part(lb: Block, rb: Block, on=on, how=how,
+                           lcols=tuple(lcols), rcols=tuple(rcols)) -> Block:
+                import pandas as pd
+
+                ldf = (pd.DataFrame(dict(lb)) if lb
+                       else pd.DataFrame({c: [] for c in lcols}))
+                rdf = (pd.DataFrame(dict(rb)) if rb
+                       else pd.DataFrame({c: [] for c in rcols}))
+                out = ldf.merge(rdf, on=on, how=how)
+                return {c: out[c].to_numpy() for c in out.columns}
+
+            return [_join_part.remote(l, r)
+                    for l, r in zip(lrefs, rrefs)]
+
+        return Dataset([_RefSource(run_join, name=f"Join({how})")])
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise zip of two row-aligned datasets (reference:
+        Dataset.zip). Right-side blocks are re-sliced to the left's block
+        boundaries by cluster tasks; duplicate column names from the right
+        get a "_1" suffix."""
+        def run_zip() -> List[Any]:
+            lrefs = list(_exec_stream(list(self._plan)))
+            rrefs = list(_exec_stream(list(other._plan)))
+
+            @ray_tpu.remote
+            def _rows(b: Block) -> int:
+                return block_num_rows(b)
+
+            lcounts = ray_tpu.get([_rows.remote(r) for r in lrefs])
+            rcounts = ray_tpu.get([_rows.remote(r) for r in rrefs])
+            if sum(lcounts) != sum(rcounts):
+                raise ValueError(
+                    f"zip needs equal row counts; {sum(lcounts)} vs "
+                    f"{sum(rcounts)}")
+
+            # Right-block spans as (global_start, global_end, ref).
+            spans = []
+            pos = 0
+            for ref, cnt in zip(rrefs, rcounts):
+                spans.append((pos, pos + cnt, ref))
+                pos += cnt
+
+            @ray_tpu.remote
+            def _zip_part(lb: Block, ranges, *rblocks) -> Block:
+                parts = [block_slice(rb, lo, hi)
+                         for rb, (lo, hi) in zip(rblocks, ranges)]
+                nonempty = [p for p in parts if block_num_rows(p)]
+                rb = block_concat(nonempty) if nonempty else {}
+                out = dict(lb)
+                for k, v in rb.items():
+                    out[k if k not in out else f"{k}_1"] = v
+                return out
+
+            out_refs = []
+            pos = 0
+            for lref, cnt in zip(lrefs, lcounts):
+                lo, hi = pos, pos + cnt
+                pos = hi
+                needed = [(s, e, r) for s, e, r in spans
+                          if e > lo and s < hi]
+                ranges = [(max(lo, s) - s, min(hi, e) - s)
+                          for s, e, _ in needed]
+                out_refs.append(_zip_part.remote(
+                    lref, ranges, *[r for _, _, r in needed]))
+            return out_refs
+
+        return Dataset([_RefSource(run_zip, name="Zip")])
+
     def split(self, n: int) -> List["Dataset"]:
         refs = list(self.iter_block_refs())
         out = []
@@ -670,47 +772,33 @@ class Dataset:
         return DataIterator(dataset=self)
 
     # -- write ------------------------------------------------------------
-    def write_parquet(self, path: str) -> None:
+    def _write_parts(self, path: str, write_part: Callable) -> None:
+        """Block-parallel write: one cluster task per block writes its own
+        part file (reference: Data write ops run as tasks in the plan, not
+        on the driver); the driver only routes refs and the final barrier
+        returns row counts."""
         import os
 
-        import pyarrow as pa
-        import pyarrow.parquet as pq
-
         os.makedirs(path, exist_ok=True)
-        for i, block in enumerate(self.iter_blocks()):
-            table = pa.table({k: list(v) if v.ndim > 1 else v
-                              for k, v in block.items()})
-            pq.write_table(table, os.path.join(path, f"part-{i:05d}.parquet"))
+
+        @ray_tpu.remote
+        def _w(block: Block, idx: int, path=path,
+               write_part=write_part) -> int:
+            write_part(block, idx, path)
+            return block_num_rows(block)
+
+        ray_tpu.get([_w.remote(ref, i)
+                     for i, ref in enumerate(self.iter_block_refs())])
+
+    def write_parquet(self, path: str) -> None:
+        self._write_parts(path, _write_parquet_part)
 
     def write_json(self, path: str) -> None:
         """One JSONL file per block (reference: Dataset.write_json)."""
-        import json
-        import os
-
-        os.makedirs(path, exist_ok=True)
-        for i, block in enumerate(self.iter_blocks()):
-            with open(os.path.join(path, f"part-{i:05d}.jsonl"), "w") as f:
-                for row in block_to_items(block):
-                    if not isinstance(row, dict):
-                        row = {VALUE_COL: row}
-                    f.write(json.dumps(
-                        {k: (v.tolist() if isinstance(v, np.ndarray)
-                             else v.item() if isinstance(v, np.generic)
-                             else v) for k, v in row.items()}) + "\n")
+        self._write_parts(path, _write_json_part)
 
     def write_csv(self, path: str) -> None:
-        import csv
-        import os
-
-        os.makedirs(path, exist_ok=True)
-        for i, block in enumerate(self.iter_blocks()):
-            cols = list(block.keys())
-            with open(os.path.join(path, f"part-{i:05d}.csv"), "w",
-                      newline="") as f:
-                w = csv.writer(f)
-                w.writerow(cols)
-                for j in _range(block_num_rows(block)):
-                    w.writerow([block[c][j] for c in cols])
+        self._write_parts(path, _write_csv_part)
 
     def to_pandas(self):
         """Materialize into one pandas DataFrame (driver memory)."""
@@ -728,6 +816,44 @@ class Dataset:
 
     def __repr__(self) -> str:
         return f"Dataset(plan={self.stats()})"
+
+
+def _write_parquet_part(block: Block, idx: int, path: str) -> None:
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    table = pa.table({k: list(v) if v.ndim > 1 else v
+                      for k, v in block.items()})
+    pq.write_table(table, os.path.join(path, f"part-{idx:05d}.parquet"))
+
+
+def _write_json_part(block: Block, idx: int, path: str) -> None:
+    import json
+    import os
+
+    with open(os.path.join(path, f"part-{idx:05d}.jsonl"), "w") as f:
+        for row in block_to_items(block):
+            if not isinstance(row, dict):
+                row = {VALUE_COL: row}
+            f.write(json.dumps(
+                {k: (v.tolist() if isinstance(v, np.ndarray)
+                     else v.item() if isinstance(v, np.generic)
+                     else v) for k, v in row.items()}) + "\n")
+
+
+def _write_csv_part(block: Block, idx: int, path: str) -> None:
+    import csv
+    import os
+
+    cols = list(block.keys())
+    with open(os.path.join(path, f"part-{idx:05d}.csv"), "w",
+              newline="") as f:
+        w = csv.writer(f)
+        w.writerow(cols)
+        for j in _range(block_num_rows(block)):
+            w.writerow([block[c][j] for c in cols])
 
 
 def _stable_hash_codes(vals, P: int) -> np.ndarray:

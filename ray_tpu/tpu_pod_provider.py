@@ -37,6 +37,32 @@ PROVISIONING = "PROVISIONING"
 RUNNING = "RUNNING"
 DELETED = "DELETED"
 
+# Generations whose hosts carry 8 chips (reference: _private/accelerators/
+# tpu.py:54-100 — v5litepod/v6e single-host-8; everything else 4/host).
+_EIGHT_CHIP_HOST_GENS = ("v5litepod", "v5e", "v6e")
+
+
+def slice_shape(accelerator_type: str) -> "tuple[int, int]":
+    """accelerator_type suffix → (hosts_per_slice, chips_per_host).
+
+    The GCE suffix counts TensorCORES for the 2-core-per-chip generations
+    (v2/v3/v4/v5p: v4-8 = 4 chips = 1 host) and CHIPS for the
+    1-core-per-chip ones (v5litepod/v5e/v6e: v5litepod-16 = 16 chips =
+    2 hosts) — reference: tpu.py get_tpu_cores_per_chip:102 +
+    get_num_tpu_visible_chips_per_host:94."""
+    gen, _, suffix = accelerator_type.partition("-")
+    try:
+        count = int(suffix)
+    except ValueError:
+        raise ValueError(
+            f"accelerator_type must be '<gen>-<count>', got "
+            f"{accelerator_type!r}") from None
+    single_core_chips = gen in _EIGHT_CHIP_HOST_GENS
+    chips = count if single_core_chips else max(1, count // 2)
+    chips_per_host = 8 if single_core_chips else 4
+    hosts = max(1, chips // chips_per_host)
+    return hosts, min(chips, chips_per_host)
+
 
 @dataclasses.dataclass
 class TPUPodConfig:
@@ -49,6 +75,14 @@ class TPUPodConfig:
     hosts_per_slice: int = 2
     chips_per_host: int = 4
     spot: bool = False
+
+    @classmethod
+    def from_accelerator(cls, accelerator_type: str,
+                         **overrides) -> "TPUPodConfig":
+        hosts, chips = slice_shape(accelerator_type)
+        return cls(accelerator_type=accelerator_type,
+                   hosts_per_slice=hosts, chips_per_host=chips,
+                   **overrides)
 
 
 @dataclasses.dataclass
@@ -78,6 +112,17 @@ class TPUPodNodeProvider(NodeProvider):
             self._nodes.extend(hosts)
 
         def on_active(backings: List[Any]) -> None:
+            if len(backings) != len(hosts):
+                # Degraded slice / topology mismatch: a partial slice is
+                # useless over ICI — fail it visibly instead of leaving
+                # unpaired hosts PROVISIONING forever.
+                logger.warning(
+                    "TPU slice %s came up with %d hosts, expected %d; "
+                    "releasing", name, len(backings), len(hosts))
+                self.transport.delete_queued_resource(name, backings)
+                on_failed(f"host count mismatch: {len(backings)} != "
+                          f"{len(hosts)}")
+                return
             with self._lock:
                 if name in self._cancelled:
                     cancelled = True
@@ -97,6 +142,13 @@ class TPUPodNodeProvider(NodeProvider):
                     self._cancelled.discard(name)  # teardown done
                 return
             logger.info("TPU slice %s ACTIVE (%d hosts)", name, len(hosts))
+            # Spot preemption / maintenance watch: a reclaimed slice drops
+            # out of nodes() so the autoscaler's next reconcile re-launches
+            # capacity, and Train's elastic path sees ordinary node deaths.
+            watch = getattr(self.transport, "watch_nodes", None)
+            if watch is not None:
+                watch(name, cfg, lambda reason: self._on_preempted(
+                    name, reason))
 
         def on_failed(reason: str) -> None:
             with self._lock:
@@ -109,6 +161,19 @@ class TPUPodNodeProvider(NodeProvider):
         self.transport.create_queued_resource(
             name, cfg, on_active=on_active, on_failed=on_failed)
         return hosts
+
+    def _on_preempted(self, slice_name: str, reason: str) -> None:
+        logger.warning("TPU slice %s preempted (%s); releasing hosts",
+                       slice_name, reason)
+        with self._lock:
+            victims = [n for n in self._nodes
+                       if n.slice_name == slice_name]
+            self._nodes[:] = [n for n in self._nodes
+                              if n.slice_name != slice_name]
+        for v in victims:
+            v.state = DELETED
+        self.transport.delete_queued_resource(
+            slice_name, [v.backing for v in victims])
 
     def terminate_node(self, node: TPUPodNode) -> None:
         # Slices terminate whole: taking down one host releases the slice
@@ -130,6 +195,10 @@ class TPUPodNodeProvider(NodeProvider):
             return [n for n in self._nodes if n.state != DELETED]
 
 
+# Alias used by autoscaler_from_yaml / external callers.
+TPUPodProvider = TPUPodNodeProvider
+
+
 class TPUTransport:
     """Control-plane operations a provider needs (QueuedResources shape)."""
 
@@ -143,14 +212,30 @@ class TPUTransport:
 
 
 class GceQueuedResourceTransport(TPUTransport):
-    """Real GCE TPU API wire shape (reference: the REST calls the GCP
-    provider issues — tpu.googleapis.com v2 queuedResources). This
-    environment has no egress; constructing without an injected `session`
-    (a requests.Session-compatible object reachable from a GCP VM) raises
-    rather than pretending to work."""
+    """Real GCE TPU control plane (reference: the REST surface the GCP
+    provider + tpu.yaml drive — tpu.googleapis.com v2 queuedResources /
+    nodes). Full lifecycle:
+
+    - create: POST queuedResources, then a poll thread follows the QR
+      state machine (WAITING_FOR_RESOURCES/PROVISIONING → ACTIVE|FAILED|
+      SUSPENDED). On ACTIVE the slice's TPU node is fetched and each
+      networkEndpoint becomes one host backing.
+    - watch: after ACTIVE, a monitor thread polls the node state; PREEMPTED
+      / TERMINATED (spot reclaim, maintenance) fires on_preempted so the
+      provider drops the slice and the autoscaler re-provisions — the
+      elastic-Train path (train/trainer.py elastic resize) picks it up as
+      a normal node death.
+    - delete: DELETE queuedResources?force=true.
+
+    This build runs with zero egress, so constructing without an injected
+    `session` (requests.Session-compatible, reachable from a GCP VM with
+    google-auth) raises rather than pretending; tests drive the whole
+    machine through a fake session that implements the same wire shapes.
+    """
 
     def __init__(self, session: Any = None,
-                 endpoint: str = "https://tpu.googleapis.com/v2"):
+                 endpoint: str = "https://tpu.googleapis.com/v2",
+                 poll_interval_s: float = 2.0):
         if session is None:
             raise RuntimeError(
                 "GceQueuedResourceTransport needs an authenticated HTTP "
@@ -158,31 +243,124 @@ class GceQueuedResourceTransport(TPUTransport):
                 "use FakeTPUTransport for local testing")
         self.session = session
         self.endpoint = endpoint
+        self.poll_interval_s = poll_interval_s
+        self._deleted: set = set()
+        self._cfgs: Dict[str, TPUPodConfig] = {}  # slice name → cfg
+
+    # -- wire shapes (methods so tests pin them without a network) -------
+    def _parent(self, cfg: TPUPodConfig) -> str:
+        return f"projects/{cfg.project}/locations/{cfg.zone}"
 
     def request_body(self, name: str, cfg: TPUPodConfig) -> Dict[str, Any]:
-        """The QueuedResource creation body (kept as a method so tests can
-        pin the wire shape without a network)."""
         return {
-            "tpu": {"node_spec": [{
-                "parent": f"projects/{cfg.project}/locations/{cfg.zone}",
-                "node_id": name,
+            "tpu": {"nodeSpec": [{
+                "parent": self._parent(cfg),
+                "nodeId": name,
                 "node": {
-                    "accelerator_type": cfg.accelerator_type,
-                    "runtime_version": cfg.runtime_version,
+                    "acceleratorType": cfg.accelerator_type,
+                    "runtimeVersion": cfg.runtime_version,
                 },
             }]},
             **({"spot": {}} if cfg.spot else {}),
         }
 
+    def _qr_url(self, cfg: TPUPodConfig, name: str) -> str:
+        return f"{self.endpoint}/{self._parent(cfg)}/queuedResources/{name}"
+
+    def _node_url(self, cfg: TPUPodConfig, name: str) -> str:
+        return f"{self.endpoint}/{self._parent(cfg)}/nodes/{name}"
+
+    # -- lifecycle -------------------------------------------------------
     def create_queued_resource(self, name, cfg, *, on_active, on_failed):
-        url = (f"{self.endpoint}/projects/{cfg.project}/locations/"
-               f"{cfg.zone}/queuedResources?queued_resource_id={name}")
+        self._cfgs[name] = cfg
+        url = (f"{self.endpoint}/{self._parent(cfg)}/queuedResources"
+               f"?queuedResourceId={name}")
         resp = self.session.post(url, json=self.request_body(name, cfg))
         if resp.status_code >= 300:
-            on_failed(f"HTTP {resp.status_code}")
+            on_failed(f"HTTP {resp.status_code}: {getattr(resp, 'text', '')}")
+            return
+        threading.Thread(
+            target=self._poll_until_active, daemon=True,
+            name=f"tpu-qr-poll-{name}",
+            args=(name, cfg, on_active, on_failed)).start()
+
+    def _poll_until_active(self, name, cfg, on_active, on_failed):
+        while name not in self._deleted:
+            try:
+                resp = self.session.get(self._qr_url(cfg, name))
+                state = (resp.json().get("state") or {}).get("state", "")
+            except Exception as e:  # noqa: BLE001
+                on_failed(f"queuedResource poll error: {e!r}")
+                return
+            if state in ("FAILED", "SUSPENDED", "SUSPENDING"):
+                on_failed(f"queuedResource state {state}")
+                return
+            if state == "ACTIVE":
+                backings = self._fetch_host_backings(name, cfg)
+                if backings is None:
+                    on_failed("slice node vanished after ACTIVE")
+                    return
+                on_active(backings)
+                return
+            time.sleep(self.poll_interval_s)
+
+    def _fetch_host_backings(self, name: str,
+                             cfg: TPUPodConfig) -> Optional[List[Any]]:
+        resp = self.session.get(self._node_url(cfg, name))
+        if resp.status_code >= 300:
+            return None
+        node = resp.json()
+        endpoints = node.get("networkEndpoints") or []
+        gen, _, topo = cfg.accelerator_type.partition("-")
+        backings = []
+        for i, ep in enumerate(endpoints):
+            resources = {"CPU": 1.0, "TPU": float(cfg.chips_per_host)}
+            if i == 0:
+                # Slice-head gang resource: STRICT_PACK PGs over
+                # TPU-<gen>-<topo>-head land whole slices
+                # (accelerators.py; reference tpu.py:110 naming).
+                resources[f"TPU-{gen}-{topo}-head"] = 1.0
+            backings.append({
+                "slice": name, "host_index": i,
+                # networkEndpoints.ipAddress is the VPC-internal address;
+                # accessConfig.externalIp the public one (if any).
+                "ip": ep.get("ipAddress", ""),
+                "external_ip": (ep.get("accessConfig") or {}).get(
+                    "externalIp", ""),
+                "resources": resources,
+                "health": node.get("health", ""),
+            })
+        return backings
+
+    def watch_nodes(self, name: str, cfg: TPUPodConfig,
+                    on_preempted: Callable[[str], None]) -> None:
+        """Monitor an ACTIVE slice for spot preemption / maintenance
+        termination (reference: GCE maintenance events the GCP provider
+        surfaces; TPU nodes report state PREEMPTED/TERMINATED)."""
+
+        def watch():
+            while name not in self._deleted:
+                try:
+                    resp = self.session.get(self._node_url(cfg, name))
+                    state = resp.json().get("state", "")
+                except Exception:
+                    state = ""
+                if state in ("PREEMPTED", "TERMINATED"):
+                    on_preempted(state)
+                    return
+                time.sleep(self.poll_interval_s)
+
+        threading.Thread(target=watch, daemon=True,
+                         name=f"tpu-watch-{name}").start()
 
     def delete_queued_resource(self, name, backings):
-        pass  # DELETE {endpoint}/.../queuedResources/{name}
+        self._deleted.add(name)
+        cfg = self._cfgs.pop(name, None)
+        if cfg is not None:
+            try:
+                self.session.delete(f"{self._qr_url(cfg, name)}?force=true")
+            except Exception:
+                logger.exception("queuedResource delete failed for %s", name)
 
 
 class FakeTPUTransport(TPUTransport):

@@ -1,0 +1,123 @@
+"""reduce / reducescatter / send / recv parity vs numpy, 8-way
+(reference: python/ray/util/collective/collective.py:358,431,560,610 and
+its CPU-communicator test shape)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.collective import collective as col
+
+WORLD = 8
+
+
+@ray_tpu.remote
+class Member:
+    def __init__(self, rank, world, group):
+        self.rank = rank
+        self.group = col.init_collective_group(world, rank, group_name=group)
+
+    def do_reduce(self, dst, op):
+        return self.group.reduce(
+            np.arange(8.0) * (self.rank + 1), dst_rank=dst, op=op)
+
+    def do_reducescatter(self, op):
+        return self.group.reducescatter(
+            np.arange(16.0) * (self.rank + 1), op=op)
+
+    def do_send(self, dst, payload):
+        self.group.send(payload, dst)
+        return "sent"
+
+    def do_recv(self, src):
+        return self.group.recv(src)
+
+    def do_send_jax(self, dst, n):
+        import jax.numpy as jnp
+
+        self.group.send(jnp.arange(float(n)) * 2.0, dst)
+        return "sent"
+
+    def do_recv_jax(self, src):
+        from ray_tpu.experimental import device_objects as devobj
+
+        out = self.group.recv(src)
+        return {
+            "is_jax": "jax" in type(out).__module__,
+            "sum": float(out.sum()),
+            "stats": devobj.transfer_stats(),
+        }
+
+
+@pytest.fixture(scope="module")
+def members(ray_cluster):
+    ms = [Member.remote(r, WORLD, "extras") for r in range(WORLD)]
+    # init rendezvous happens in __init__; touch all
+    ray_tpu.get([m.do_send.remote((r + 1) % WORLD, r)
+                 for r, m in enumerate(ms)])
+    ray_tpu.get([m.do_recv.remote((r - 1) % WORLD)
+                 for r, m in enumerate(ms)])
+    return ms
+
+
+def test_reduce_delivers_to_dst_only(ray_start_regular, members):
+    outs = ray_tpu.get([m.do_reduce.remote(3, "sum") for m in members],
+                       timeout=120)
+    expected = np.arange(8.0) * sum(range(1, WORLD + 1))
+    for rank, out in enumerate(outs):
+        if rank == 3:
+            np.testing.assert_allclose(out, expected)
+        else:
+            assert out is None
+
+
+def test_reduce_ops_parity(ray_start_regular, members):
+    outs = ray_tpu.get([m.do_reduce.remote(0, "max") for m in members],
+                       timeout=120)
+    np.testing.assert_allclose(outs[0], np.arange(8.0) * WORLD)
+    outs = ray_tpu.get([m.do_reduce.remote(0, "min") for m in members],
+                       timeout=120)
+    np.testing.assert_allclose(outs[0], np.arange(8.0) * 1)
+
+
+def test_reducescatter_parity(ray_start_regular, members):
+    outs = ray_tpu.get([m.do_reducescatter.remote("sum") for m in members],
+                       timeout=120)
+    full = np.arange(16.0) * sum(range(1, WORLD + 1))
+    chunks = np.array_split(full, WORLD)
+    for rank, out in enumerate(outs):
+        np.testing.assert_allclose(out, chunks[rank])
+
+
+def test_send_recv_ring(ray_start_regular, members):
+    # every rank sends its id to (rank+1) % WORLD, receives from its left
+    sends = [m.do_send.remote((r + 1) % WORLD, {"from": r})
+             for r, m in enumerate(members)]
+    recvs = [m.do_recv.remote((r - 1) % WORLD)
+             for r, m in enumerate(members)]
+    ray_tpu.get(sends, timeout=120)
+    outs = ray_tpu.get(recvs, timeout=120)
+    for rank, out in enumerate(outs):
+        assert out == {"from": (rank - 1) % WORLD}
+
+
+def test_send_recv_ordering(ray_start_regular, members):
+    # two back-to-back messages on one pair arrive in order
+    a, b = members[0], members[1]
+    ray_tpu.get([a.do_send.remote(1, "first"), a.do_send.remote(1, "second")],
+                timeout=60)
+    assert ray_tpu.get(b.do_recv.remote(0), timeout=60) == "first"
+    assert ray_tpu.get(b.do_recv.remote(0), timeout=60) == "second"
+
+
+def test_send_recv_jax_rides_device_plane(ray_start_regular, members):
+    """jax.Array p2p payloads move over the device-object plane (shm on
+    one host), not through the coordinator as pickled host bytes."""
+    s = members[2].do_send_jax.remote(5, 32)
+    out = ray_tpu.get(members[5].do_recv_jax.remote(2), timeout=120)
+    ray_tpu.get(s, timeout=60)
+    assert out["is_jax"]
+    assert out["sum"] == float((np.arange(32.0) * 2.0).sum())
+    assert (out["stats"]["shm_staging_fetches"]
+            + out["stats"]["mesh_collective_fetches"]
+            + out["stats"]["local_hits"]) >= 1, out["stats"]

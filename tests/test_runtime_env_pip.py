@@ -1,0 +1,126 @@
+"""pip/uv runtime environments: per-env-hash venvs, worker runs under the
+venv interpreter (reference: _private/runtime_env/{pip,uv}.py). Zero-egress
+build: packages install from a locally constructed wheel via --no-index."""
+
+import base64
+import hashlib
+import os
+import threading
+import zipfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env import ensure_pip_venv, pip_env_hash
+
+PKG = "rtenv_probe"
+VERSION = "1.2.3"
+
+
+def _build_wheel(dirpath) -> str:
+    """A minimal valid pure-python wheel, by hand — no network, no build
+    backend."""
+    name = f"{PKG}-{VERSION}-py3-none-any.whl"
+    os.makedirs(str(dirpath), exist_ok=True)
+    path = os.path.join(str(dirpath), name)
+    files = {
+        f"{PKG}/__init__.py": f'VERSION = "{VERSION}"\n',
+        f"{PKG}-{VERSION}.dist-info/METADATA":
+            f"Metadata-Version: 2.1\nName: {PKG}\nVersion: {VERSION}\n",
+        f"{PKG}-{VERSION}.dist-info/WHEEL":
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n",
+    }
+    record_name = f"{PKG}-{VERSION}.dist-info/RECORD"
+    record_lines = []
+    with zipfile.ZipFile(path, "w") as z:
+        for arc, content in files.items():
+            data = content.encode()
+            z.writestr(arc, data)
+            digest = base64.urlsafe_b64encode(
+                hashlib.sha256(data).digest()).rstrip(b"=").decode()
+            record_lines.append(f"{arc},sha256={digest},{len(data)}")
+        record_lines.append(f"{record_name},,")
+        z.writestr(record_name, "\n".join(record_lines) + "\n")
+    return str(dirpath)
+
+
+def _spec(wheel_dir: str):
+    return {"packages": [PKG], "options": ["--no-index", "--find-links",
+                                           wheel_dir]}
+
+
+def test_ensure_pip_venv_builds_and_caches(tmp_path):
+    import subprocess
+    import sys
+
+    wheel_dir = _build_wheel(tmp_path / "wheels")
+    venvs = str(tmp_path / "venvs")
+    py = ensure_pip_venv(_spec(wheel_dir), venvs)
+    assert os.path.exists(py)
+    out = subprocess.run(
+        [py, "-c", f"import {PKG}; print({PKG}.VERSION)"],
+        capture_output=True, text=True)
+    assert out.stdout.strip() == VERSION, out.stderr
+    # the DRIVER interpreter must NOT see it (isolation)
+    probe = subprocess.run(
+        [sys.executable, "-c", f"import {PKG}"], capture_output=True)
+    assert probe.returncode != 0
+    # cached: second call returns instantly with the same interpreter
+    assert ensure_pip_venv(_spec(wheel_dir), venvs) == py
+    # same content hash → one venv dir
+    assert len([d for d in os.listdir(venvs)
+                if not d.startswith(".")]) == 1
+
+
+def test_concurrent_creation_builds_once(tmp_path):
+    wheel_dir = _build_wheel(tmp_path / "wheels")
+    venvs = str(tmp_path / "venvs")
+    results, errors = [], []
+
+    def build():
+        try:
+            results.append(ensure_pip_venv(_spec(wheel_dir), venvs))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=build) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert len(set(results)) == 1
+    assert len([d for d in os.listdir(venvs)
+                if not d.startswith(".")]) == 1
+
+
+def test_env_hash_stability():
+    a = pip_env_hash({"packages": ["x", "y"], "options": ["-q"]})
+    b = pip_env_hash({"packages": ["y", "x"], "options": ["-q"]})
+    c = pip_env_hash({"packages": ["x"], "options": ["-q"]})
+    assert a == b  # order-insensitive
+    assert a != c
+
+
+def test_task_runs_inside_pip_env(ray_start_regular, tmp_path):
+    """E2E: a task whose runtime_env requests a package the driver lacks
+    imports it — because its worker runs under the env's interpreter."""
+    wheel_dir = _build_wheel(tmp_path / "wheels")
+
+    @ray_tpu.remote
+    def probe():
+        import sys
+
+        import rtenv_probe  # noqa: F401  (driver env does NOT have this)
+
+        return rtenv_probe.VERSION, sys.executable
+
+    with pytest.raises(Exception):
+        ray_tpu.get(probe.remote(), timeout=60)  # no runtime_env → fails
+
+    version, exe = ray_tpu.get(
+        probe.options(runtime_env={"pip": _spec(wheel_dir)}).remote(),
+        timeout=300)
+    assert version == VERSION
+    assert "venvs" in exe  # ran under the per-env interpreter

@@ -27,10 +27,158 @@ def test_gce_transport_wire_shape():
     body = t.request_body("qr-x", TPUPodConfig(
         accelerator_type="v5e-16", project="p", zone="us-central2-b",
         spot=True))
-    spec = body["tpu"]["node_spec"][0]
+    spec = body["tpu"]["nodeSpec"][0]
     assert spec["parent"] == "projects/p/locations/us-central2-b"
-    assert spec["node"]["accelerator_type"] == "v5e-16"
+    assert spec["nodeId"] == "qr-x"
+    assert spec["node"]["acceleratorType"] == "v5e-16"
+    assert "runtimeVersion" in spec["node"]
     assert "spot" in body
+
+
+def test_slice_shape_topology_mapping():
+    from ray_tpu.tpu_pod_provider import TPUPodConfig, slice_shape
+
+    # v5e/v5litepod/v6e suffixes count CHIPS (1 core each, 8 per host);
+    # v2..v5p suffixes count CORES (2 per chip, 4 chips per host).
+    assert slice_shape("v5e-8") == (1, 8)
+    assert slice_shape("v5litepod-16") == (2, 8)
+    assert slice_shape("v6e-32") == (4, 8)
+    assert slice_shape("v4-8") == (1, 4)       # 4 chips, single host
+    assert slice_shape("v4-16") == (2, 4)      # 8 chips, 2 hosts
+    assert slice_shape("v5p-4") == (1, 2)      # 2 chips
+    assert slice_shape("v3-32") == (4, 4)
+    cfg = TPUPodConfig.from_accelerator("v5litepod-16", project="p",
+                                        zone="z")
+    assert (cfg.hosts_per_slice, cfg.chips_per_host) == (2, 8)
+    with pytest.raises(ValueError, match="gen"):
+        slice_shape("v5e")
+
+
+class _Resp:
+    def __init__(self, status_code=200, payload=None, text=""):
+        self.status_code = status_code
+        self._payload = payload or {}
+        self.text = text
+
+    def json(self):
+        return self._payload
+
+
+class FakeGceSession:
+    """In-memory tpu.googleapis.com v2 control plane: queuedResources go
+    WAITING_FOR_RESOURCES → ACTIVE after `activate_after` GET polls; nodes
+    report READY with one networkEndpoint per host; preempt() flips a node
+    to PREEMPTED (spot reclaim)."""
+
+    def __init__(self, hosts_per_slice=2, activate_after=1):
+        self.hosts_per_slice = hosts_per_slice
+        self.activate_after = activate_after
+        self.qrs = {}
+        self.nodes = {}
+        self.create_calls = []
+        self.delete_calls = []
+
+    def post(self, url, json=None):
+        name = url.split("queuedResourceId=")[-1]
+        self.create_calls.append((name, json))
+        self.qrs[name] = {"state": "WAITING_FOR_RESOURCES", "polls": 0}
+        self.nodes[name] = {
+            "state": "CREATING",
+            "health": "HEALTHY",
+            "networkEndpoints": [
+                {"ipAddress": f"10.0.0.{i + 1}"}
+                for i in range(self.hosts_per_slice)],
+        }
+        return _Resp(200)
+
+    def get(self, url):
+        name = url.rstrip("/").split("/")[-1]
+        if "/queuedResources/" in url:
+            qr = self.qrs.get(name)
+            if qr is None:
+                return _Resp(404)
+            qr["polls"] += 1
+            if (qr["state"] == "WAITING_FOR_RESOURCES"
+                    and qr["polls"] >= self.activate_after):
+                qr["state"] = "ACTIVE"
+                self.nodes[name]["state"] = "READY"
+            return _Resp(200, {"state": {"state": qr["state"]}})
+        node = self.nodes.get(name)
+        if node is None:
+            return _Resp(404)
+        return _Resp(200, node)
+
+    def delete(self, url):
+        name = url.rstrip("/").split("/")[-1].split("?")[0]
+        self.qrs.pop(name, None)
+        self.nodes.pop(name, None)
+        self.delete_calls.append(name)
+        return _Resp(200)
+
+    def preempt(self, name):
+        self.nodes[name]["state"] = "PREEMPTED"
+
+
+def test_gce_lifecycle_create_active_preempt_replace():
+    """The full loop on the fake HTTP control plane: demand → POST create →
+    poll to ACTIVE (hosts RUNNING with endpoints + slice-head resource) →
+    spot preemption → hosts released + QR deleted → next reconcile
+    re-provisions a replacement slice."""
+    from ray_tpu.autoscaler import Autoscaler
+    from ray_tpu.tpu_pod_provider import (
+        GceQueuedResourceTransport,
+        TPUPodConfig,
+        TPUPodNodeProvider,
+    )
+
+    session = FakeGceSession(hosts_per_slice=2, activate_after=1)
+    transport = GceQueuedResourceTransport(
+        session=session, poll_interval_s=0.05)
+    cfg = TPUPodConfig.from_accelerator(
+        "v5litepod-16", project="proj", zone="us-central2-b", spot=True)
+    provider = TPUPodNodeProvider(cfg, transport)
+    scaler = Autoscaler(provider, min_workers=0, max_workers=2,
+                        idle_timeout_s=300.0)
+
+    demand = [{"TPU-v5litepod-16-head": 1.0, "TPU": 8.0}]
+    scaler._pending_demand = lambda: demand  # drive reconcile directly
+
+    # 1. demand → one QueuedResource POST, hosts PROVISIONING
+    scaler.update()
+    assert len(session.create_calls) == 1
+    assert len(provider.nodes()) == 2
+    # 2. reconcile while provisioning must NOT double-provision
+    scaler.update()
+    assert len(session.create_calls) == 1
+
+    # 3. control plane activates → hosts RUNNING with endpoints
+    deadline = time.monotonic() + 10
+    while (any(n.state != "RUNNING" for n in provider.nodes())
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    nodes = provider.nodes()
+    assert [n.state for n in nodes] == ["RUNNING", "RUNNING"]
+    assert nodes[0].backing["ip"] == "10.0.0.1"
+    assert nodes[0].backing["resources"].get(
+        "TPU-v5litepod-16-head") == 1.0
+    assert nodes[1].backing["resources"].get("TPU") == 8.0
+    demand = []
+
+    # 4. spot reclaim → watch fires → hosts released, QR deleted
+    qr_name = session.create_calls[0][0]
+    session.preempt(qr_name)
+    deadline = time.monotonic() + 10
+    while provider.nodes() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert provider.nodes() == []
+    assert qr_name in session.delete_calls
+
+    # 5. demand returns → replacement slice provisioned
+    demand = [{"TPU-v5litepod-16-head": 1.0, "TPU": 8.0}]
+    scaler._pending_demand = lambda: demand
+    scaler.update()
+    assert len(session.create_calls) == 2
+    assert session.create_calls[1][0] != qr_name
 
 
 def test_tpu_slice_provisions_and_schedules_gang():
