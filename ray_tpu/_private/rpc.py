@@ -205,8 +205,11 @@ class RpcServer:
                 self.register(prefix + name, fn)
 
     async def start(self) -> Tuple[str, int]:
+        # limit: StreamReader's default 64KiB buffer makes readexactly() of
+        # a multi-MB oob frame pause/resume flow control ~128x per chunk —
+        # measured 0.33 GiB/s loopback ceiling; 16 MiB reads at memcpy speed.
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port
+            self._handle_conn, self.host, self.port, limit=16 * 1024 * 1024
         )
         self.port = self._server.sockets[0].getsockname()[1]
         return self.host, self.port
@@ -317,7 +320,7 @@ class RpcClient:
             if self._writer is not None:
                 return
             self._reader, self._writer = await asyncio.open_connection(
-                self.host, self.port
+                self.host, self.port, limit=16 * 1024 * 1024
             )
             self._read_task = asyncio.ensure_future(self._read_loop())
 

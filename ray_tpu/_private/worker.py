@@ -1155,13 +1155,15 @@ class Worker:
             async with self._pull_sem:
                 t = (None if deadline is None
                      else deadline - time.monotonic())
-                data = await client.call(
+                reply = await client.call(
                     "fetch_object_chunk", object_id=object_id.binary(),
                     offset=off, length=length, timeout=t)
-            if data is None:
+            if reply is None:
                 raise ObjectLostError(
                     f"object {object_id} vanished mid-transfer")
-            flat[off:off + len(data)] = data
+            data = reply["data"] if isinstance(reply, dict) else reply
+            with memoryview(data) as mv:
+                flat[off:off + mv.nbytes] = mv
 
         tasks = [asyncio.ensure_future(pull_one(off))
                  for off in range(0, total, chunk)]

@@ -1,0 +1,120 @@
+"""Scale-envelope mini-suite (reference: release/benchmarks — many_actors /
+many_tasks / many_pgs / object_store broadcast. Those run on 64-node
+clusters; this suite runs the same SHAPES at single-host scale so the
+envelope is measured, not assumed: rates recorded vs the reference's
+cluster-scale numbers with the hardware gap stated, and the failure mode
+being probed is collapse (non-linear slowdown / leak / deadlock), not raw
+throughput parity).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+
+def many_actors_bench(ray_tpu, *, total: int = 1000,
+                      window: int = 50) -> Dict[str, Any]:
+    """Create/ping/destroy `total` actors in rolling windows (reference:
+    many_actors.json — 553.5 actors/s at 10k on an Anyscale cluster)."""
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return 1
+
+    t0 = time.perf_counter()
+    made = 0
+    while made < total:
+        n = min(window, total - made)
+        actors = [A.remote() for _ in range(n)]
+        ray_tpu.get([a.ping.remote() for a in actors])
+        for a in actors:
+            ray_tpu.kill(a)
+        made += n
+    dt = time.perf_counter() - t0
+    return {"actors": total, "actors_per_s": round(total / dt, 1),
+            "baseline": 553.5, "baseline_note": "10k actors, multi-node"}
+
+
+def many_tasks_bench(ray_tpu, *, total: int = 10_000) -> Dict[str, Any]:
+    """Queue `total` no-op tasks at once and drain (reference:
+    many_tasks.json — 381.5/s for 10k SLEEPING tasks over 2500 CPUs; ours
+    are no-ops on one host, so the probe is queue pressure, not compute)."""
+    @ray_tpu.remote
+    def nop():
+        return None
+
+    ray_tpu.get(nop.remote())
+    t0 = time.perf_counter()
+    refs = [nop.remote() for _ in range(total)]
+    submit_s = time.perf_counter() - t0
+    ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    return {"tasks": total, "submit_per_s": round(total / submit_s, 1),
+            "drain_per_s": round(total / dt, 1), "baseline": 381.5,
+            "baseline_note": "10k long tasks across 2500 CPUs"}
+
+
+def many_pgs_bench(ray_tpu, *, total: int = 200) -> Dict[str, Any]:
+    """Create+ready+remove `total` placement groups (reference:
+    many_pgs.json — 13.3 pg/s for 1k PGs cluster-wide)."""
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    t0 = time.perf_counter()
+    for _ in range(total):
+        pg = placement_group([{"CPU": 0.001}])
+        pg.ready(timeout=30)
+        remove_placement_group(pg)
+    dt = time.perf_counter() - t0
+    return {"pgs": total, "pgs_per_s": round(total / dt, 1),
+            "baseline": 13.3, "baseline_note": "1k PGs, multi-node"}
+
+
+def broadcast_bench(ray_tpu, cluster, *, n_nodes: int = 4,
+                    size_mb: int = 1024) -> Dict[str, Any]:
+    """1 GiB object broadcast to `n_nodes` worker nodelets (reference:
+    object_store.json — 12.6 s to 50 nodes). Each consumer is an actor
+    pinned to its own nodelet via node resources; the get pulls the object
+    through the chunked cross-node transfer path."""
+    import numpy as np
+
+    for i in range(n_nodes):
+        cluster.add_node(num_cpus=1, resources={f"bcast{i}": 1.0},
+                         object_store_memory=int(size_mb * 1.5) * 2**20)
+
+    @ray_tpu.remote
+    class Puller:
+        def pull(self, ref):
+            return int(ref[-1])  # materialized on THIS node
+
+    pullers = [Puller.options(resources={f"bcast{i}": 0.5}).remote()
+               for i in range(n_nodes)]
+    arr = np.ones(size_mb * 2**20, np.uint8)
+    ref = ray_tpu.put(arr)
+    t0 = time.perf_counter()
+    assert ray_tpu.get([p.pull.remote(ref) for p in pullers],
+                       timeout=600) == [1] * n_nodes
+    dt = time.perf_counter() - t0
+    return {"nodes": n_nodes, "size_mb": size_mb,
+            "broadcast_s": round(dt, 2),
+            "gbps_aggregate": round(size_mb * n_nodes / 1024 / dt, 2),
+            "baseline": 12.6, "baseline_note": "1 GiB to 50 nodes"}
+
+
+def run_scale_suite(ray_tpu, cluster=None,
+                    progress=None) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for name, fn in (("many_actors", many_actors_bench),
+                     ("many_tasks", many_tasks_bench),
+                     ("many_pgs", many_pgs_bench)):
+        out[name] = fn(ray_tpu)
+        if progress:
+            progress(f"{name}: {out[name]}")
+    if cluster is not None:
+        out["broadcast"] = broadcast_bench(ray_tpu, cluster)
+        if progress:
+            progress(f"broadcast: {out['broadcast']}")
+    return out

@@ -33,6 +33,7 @@ import numpy as np
 from ray_tpu.llm._internal.paged import (
     PageAllocator,
     PagedCacheConfig,
+    PrefixCache,
     init_paged_cache,
 )
 from ray_tpu.utils.logging import get_logger
@@ -51,6 +52,12 @@ class EngineConfig:
     # vLLM's num_scheduler_steps): amortizes host dispatch over K tokens at
     # the cost of up to K-1 wasted tokens past a stop condition.
     decode_steps: int = 8
+    # Full prompt pages are indexed by content hash and shared across
+    # requests (the engine-side cache the prefix-aware router assumes).
+    enable_prefix_cache: bool = True
+    # Overlap host scheduling with device compute: dispatch decode window
+    # N+1 from window N's DEVICE outputs before N's tokens reach the host.
+    pipeline_dispatch: bool = True
 
     def resolved_num_pages(self) -> int:
         return self.num_pages or self.max_seqs * self.max_pages_per_seq
@@ -144,6 +151,12 @@ class LLMEngine:
         self._decode_fn = self._build_decode()
         self._prefill_fns: Dict[int, Callable] = {}
         self._free_slots = list(range(cfg.max_seqs))
+        self.prefix_cache = (PrefixCache(self.allocator)
+                             if cfg.enable_prefix_cache else None)
+        # Pipelined dispatch state: the in-flight window's device arrays
+        # (tokens [K,B], final last_tokens [B], final seq_lens [B]) plus
+        # the slot set it was dispatched for.
+        self._inflight: Optional[Tuple[Any, Any, Any, frozenset]] = None
 
     # ------------------------------------------------------------------
     # Jitted steps
@@ -183,9 +196,11 @@ class LLMEngine:
                                         lens, active, temps, rng)
                 return caches, toks, lens + 1, rng, out.at[j].set(toks)
 
-            caches, _, _, rng, out = jax.lax.fori_loop(
+            caches, last, lens, rng, out = jax.lax.fori_loop(
                 0, K, body, (caches, last_tokens, seq_lens, rng, out))
-            return out, caches, rng
+            # Final last_tokens/seq_lens feed the NEXT window's dispatch
+            # without a host round trip (pipeline_dispatch).
+            return out, last, lens, caches, rng
 
         return jax.jit(decode, donate_argnums=(1,))
 
@@ -197,17 +212,19 @@ class LLMEngine:
 
         transform = self.param_transform
 
-        def prefill(params, caches, ids, page_table_row, true_len,
+        def prefill(params, caches, ids, page_table_row, start, true_len,
                     temps, rng):
             if transform is not None:
                 params = transform(params)
-            # ids [1, bucket]; single sequence, causal within the bucket.
-            positions = jnp.arange(bucket)[None, :]
-            mask = positions < true_len
+            # ids [1, bucket] = the SUFFIX of the prompt from absolute
+            # position `start` (start > 0 when a cached prefix run was
+            # shared into the page table); causal within the sequence.
+            positions = start + jnp.arange(bucket)[None, :]
+            mask = jnp.arange(bucket)[None, :] < true_len
             logits, new_caches = model.apply(
                 {"params": params}, ids, positions=positions,
                 paged_kv=caches, page_table=page_table_row[None, :],
-                write_mask=mask, seq_lens=jnp.full((1,), true_len))
+                write_mask=mask, seq_lens=jnp.full((1,), start + true_len))
             last = logits[0, true_len - 1].astype(jnp.float32)
             greedy = jnp.argmax(last)
             k1, k0 = jax.random.split(rng)
@@ -248,22 +265,99 @@ class LLMEngine:
         return len(self.running)
 
     def step(self) -> List[StepOutput]:
-        """Admit + prefill waiting requests, then one decode step."""
+        """Admit + prefill waiting requests, then one decode window.
+
+        With pipeline_dispatch, the next window is dispatched from the
+        in-flight window's DEVICE outputs before its tokens reach the
+        host, so host-side stop/stream handling overlaps device compute
+        (the "enqueue N+1 before N returns" scheme; reference analog:
+        vLLM async scheduling). The pipeline drains to a sync point when
+        the slot set changes (admit/finish) — the next dispatch then
+        rebuilds control state from the host mirrors."""
         out: List[StepOutput] = []
-        self._admit(out)
+        admitted = self._admit(out)
         if not self.running:
+            if self._inflight is not None:
+                self._process_window(self._inflight, out)
+                self._inflight = None
             return out
+        if admitted and self._inflight is not None:
+            # Admission changed active/temps/last_tokens: the in-flight
+            # window predates it — drain before dispatching from host.
+            self._process_window(self._inflight, out)
+            self._inflight = None
+            if not self.running:
+                return out
         K = max(1, self.cfg.decode_steps)
-        self._ensure_decode_pages(K)
+        if self._inflight is None:
+            self._ensure_decode_pages(K)
+            self._inflight = self._dispatch_window_from_host()
+            if not self.cfg.pipeline_dispatch:
+                self._process_window(self._inflight, out)
+                self._inflight = None
+            return out
+        # Pipelined: cover the NEXT window's writes too, then chain the
+        # dispatch off the in-flight window's device state. Skip the chain
+        # when every request ends inside the in-flight window — the chained
+        # window would be pure waste.
+        if all(r.generated + K >= r.max_tokens
+               for r in self.running.values()):
+            self._process_window(self._inflight, out)
+            self._inflight = None
+            return out
+        self._ensure_decode_pages(2 * K)
+        nxt = self._dispatch_window_from_device(self._inflight)
+        finished = self._process_window(self._inflight, out)
+        if finished:
+            # The chained window ran with pre-finish control state. Its
+            # tokens are still VALID for surviving slots (their device
+            # last/lens were correct); finished slots are skipped by the
+            # processing loop, and their stale page writes are harmless:
+            # released pages get re-prefilled by strictly later programs
+            # on the ordered device stream. Process it now and resync from
+            # host state on the next step.
+            self._process_window(nxt, out)
+            self._inflight = None
+        else:
+            self._inflight = nxt
+        return out
+
+    def _dispatch_window_from_host(self):
         active = np.zeros((self.cfg.max_seqs,), bool)
         for slot in self.running:
             active[slot] = True
-        toks, self.caches, self._rng = self._decode_fn(
+        toks, last, lens, self.caches, self._rng = self._decode_fn(
             self.params, self.caches, self._dev(self.last_tokens),
             self._dev(self.page_table), self._dev(self.seq_lens),
             self._dev(active), self._dev(self.temps), self._rng)
-        toks = np.asarray(toks)  # [K, B]
-        for slot, req in list(self.running.items()):
+        return (toks, last, lens, frozenset(self.running))
+
+    def _dispatch_window_from_device(self, window):
+        _, last, lens, slots = window
+        active = np.zeros((self.cfg.max_seqs,), bool)
+        for slot in self.running:
+            active[slot] = True
+        toks, last, lens, self.caches, self._rng = self._decode_fn(
+            self.params, self.caches, last,
+            self._dev(self.page_table), lens,
+            self._dev(active), self._dev(self.temps), self._rng)
+        return (toks, last, lens, frozenset(self.running))
+
+    def _process_window(self, window,
+                        out: Optional[List[StepOutput]]) -> bool:
+        """Block on a window's tokens; update host mirrors and emit
+        outputs. out=None discards (pipeline drain). Returns True if any
+        slot finished."""
+        toks, _, _, slots = window
+        toks = np.asarray(toks)  # [K, B] (blocks here)
+        if out is None:
+            return False
+        K = toks.shape[0]
+        finished_any = False
+        for slot in slots:
+            req = self.running.get(slot)
+            if req is None:
+                continue
             for j in range(K):
                 tok = int(toks[j, slot])
                 self.seq_lens[slot] += 1
@@ -277,49 +371,112 @@ class LLMEngine:
                     # Tokens past the stop within this window are wasted
                     # compute (multi-step tradeoff); drop them.
                     self._release(slot)
+                    finished_any = True
                     break
-        return out
+        return finished_any
 
-    def _admit(self, out: List[StepOutput]) -> None:
+    def _admit(self, out: List[StepOutput]) -> bool:
+        """Admit as many waiting requests as fit. Prefills dispatch
+        back-to-back WITHOUT a host sync in between (the first token stays
+        on device until every admitted prefill is in flight), so TTFT for
+        a wave of admissions is one pipelined pass over the weights, not
+        N serial host round trips."""
+        admitted = False
+        pending: List[Tuple[int, Request, Any]] = []  # slot, req, dev tok
+        ps = self.cache_cfg.page_size
         while self.waiting and self._free_slots:
             req: Request = self.waiting[0]
-            need = len(req.prompt_ids) + 1  # prompt + first decode page room
-            if not self.allocator.can_allocate(need):
-                break  # wait for running requests to free pages
+            T = len(req.prompt_ids)
+            # Prefix reuse: share the longest cached run of FULL prompt
+            # pages into this slot; prefill then runs only on the suffix.
+            # At least one real token must go through prefill (it produces
+            # the first sampled token), so a whole-prompt hit backs off by
+            # one page.
+            digests: List[Any] = []
+            shared: List[int] = []
+            if self.prefix_cache is not None:
+                digests = self.prefix_cache.page_digests(req.prompt_ids, ps)
+                shared = self.prefix_cache.match(digests)
+                if len(shared) * ps >= T:
+                    shared = shared[:(T - 1) // ps]
+                # PIN the matched pages before any eviction below can see
+                # them as cache-only (ref==1) and hand them to the free
+                # list — a page must never be shared and free at once.
+                for p in shared:
+                    self.allocator.retain(p)
+            cached_len = len(shared) * ps
+            fresh_tokens = T + 1 - cached_len  # suffix + first decode room
+            if not self.allocator.can_allocate(fresh_tokens):
+                deficit = (self.allocator.pages_needed(fresh_tokens)
+                           - self.allocator.num_free)
+                if self.prefix_cache is not None and deficit > 0:
+                    self.prefix_cache.evict(deficit)
+                if not self.allocator.can_allocate(fresh_tokens):
+                    for p in shared:  # unpin: not admitting
+                        self.allocator.unref(p)
+                    break  # wait for running requests to free pages
             self.waiting.popleft()
+            admitted = True
             slot = self._free_slots.pop()
             req.slot = slot
             self.running[slot] = req
-            pages = self.allocator.ensure(slot, need)
+            if shared:
+                # transfer the admission pins to the slot
+                self.allocator.adopt(slot, shared)
+            pages = self.allocator.ensure(slot, T + 1)
             row = np.zeros((self.cfg.max_pages_per_seq,), np.int32)
             row[:len(pages)] = pages
             self.page_table[slot] = row
-            T = len(req.prompt_ids)
-            bucket = next((b for b in self.cfg.prefill_buckets if b >= T),
+            suffix = req.prompt_ids[cached_len:]
+            S = len(suffix)
+            bucket = next((b for b in self.cfg.prefill_buckets if b >= S),
                           self.cache_cfg.max_context)
             ids = np.zeros((1, bucket), np.int32)
-            ids[0, :T] = req.prompt_ids
+            ids[0, :S] = suffix
             self.temps[slot] = req.temperature
-            tok, self.caches, self._rng = self._prefill_fn(bucket)(
+            dev_tok, self.caches, self._rng = self._prefill_fn(bucket)(
                 self.params, self.caches, self._dev(ids),
-                self._dev(row), self._dev(np.int32(T)),
+                self._dev(row), self._dev(np.int32(cached_len)),
+                self._dev(np.int32(S)),
                 self._dev(np.float32(req.temperature)), self._rng)
-            tok = int(tok)
+            if self.prefix_cache is not None and digests:
+                # Index this prompt's full pages (now being materialized
+                # in program order) for future requests; no-op for runs
+                # already cached.
+                n_full = len(digests)
+                self.prefix_cache.insert(
+                    digests, self.allocator.slot_pages[slot][:n_full])
             self.seq_lens[slot] = T
-            self.last_tokens[slot] = tok
             req.generated = 1
+            pending.append((slot, req, dev_tok))
+        for slot, req, dev_tok in pending:
+            tok = int(dev_tok)  # sync: by now all prefills are in flight
+            self.last_tokens[slot] = tok
             finished = (req.generated >= req.max_tokens
                         or (req.stop_token is not None
                             and tok == req.stop_token))
             out.append(StepOutput(req.request_id, tok, finished))
             if finished:
                 self._release(slot)
+        return admitted
 
     def _ensure_decode_pages(self, k: int = 1) -> None:
         """Each running slot is about to append up to k tokens starting at
-        seq_lens[slot]; grow its page list to cover them."""
+        seq_lens[slot]; grow its page list to cover them. Cache-held prefix
+        pages are evictable fuel here too — decode growth must not die on
+        MemoryError while reclaimable pages exist."""
         for slot in list(self.running):
-            pages = self.allocator.ensure(slot, int(self.seq_lens[slot]) + k)
+            need = int(self.seq_lens[slot]) + k
+            try:
+                pages = self.allocator.ensure(slot, need)
+            except MemoryError:
+                if self.prefix_cache is None:
+                    raise
+                deficit = (self.allocator.pages_needed(need)
+                           - len(self.allocator.slot_pages[slot])
+                           - self.allocator.num_free)
+                self.prefix_cache.evict(max(1, deficit))
+                pages = self.allocator.ensure(slot, need)
             row = self.page_table[slot]
             row[:len(pages)] = pages
 

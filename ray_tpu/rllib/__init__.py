@@ -21,9 +21,18 @@ from ray_tpu.rllib.learner import (
     compute_gae,
 )
 from ray_tpu.rllib.ppo import PPO, PPOConfig
-from ray_tpu.rllib.rl_module import RLModule
+from ray_tpu.rllib.rl_module import ConvActorCriticNet, RLModule
+from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner, SACModule
+from ray_tpu.rllib.vector import SyncVectorEnv, as_batch_env
 
 __all__ = [
+    "ConvActorCriticNet",
+    "SAC",
+    "SACConfig",
+    "SACLearner",
+    "SACModule",
+    "SyncVectorEnv",
+    "as_batch_env",
     "DQN",
     "DQNConfig",
     "DQNLearner",

@@ -15,6 +15,12 @@ from ray_tpu.rllib.rl_module import RLModule
 
 
 class SingleAgentEnvRunner:
+    """Steps a VECTORIZED env (rllib/vector.py): one batched inference +
+    one batched env step per timestep. env_fn may build a single env
+    (wrapped num_envs-wide in SyncVectorEnv) or a natively-batched env
+    exposing step_batch — e.g. examples/pixel_gridworld.py — which is the
+    fast path (array-op simulation, no per-env python loop)."""
+
     def __init__(self, env_fn, module: RLModule, num_envs: int = 4,
                  seed: int = 0):
         import os
@@ -22,26 +28,28 @@ class SingleAgentEnvRunner:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         import jax
 
-        self.envs = [env_fn() for _ in range(num_envs)]
+        from ray_tpu.rllib.vector import as_batch_env
+
+        self.vec = as_batch_env(env_fn, num_envs, seed)
+        self.num_envs = self.vec.num_envs
         self.module = module
         self.params = None
         self._key = jax.random.PRNGKey(seed)
-        self.obs = np.stack([e.reset(seed=seed + i)[0]
-                             for i, e in enumerate(self.envs)])
-        self._ep_returns = np.zeros(num_envs)
+        self.obs = np.asarray(self.vec.reset_all())
+        self._ep_returns = np.zeros(self.num_envs)
         self._done_returns: List[float] = []
 
     def set_weights(self, params) -> None:
         self.params = params
 
     def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
-        """Rollout num_steps per env. Returns flat [T*N, ...] arrays plus
+        """Rollout num_steps per env. Returns [T, N, ...] arrays plus
         bootstrap values/flags for GAE."""
         import jax
 
-        n = len(self.envs)
+        n = self.num_envs
         obs_buf = np.empty((num_steps, n) + self.obs.shape[1:], np.float32)
-        act_buf = np.empty((num_steps, n), np.int64)
+        act_buf: Optional[np.ndarray] = None  # dtype/shape from the module
         logp_buf = np.empty((num_steps, n), np.float32)
         val_buf = np.empty((num_steps, n), np.float32)
         rew_buf = np.empty((num_steps, n), np.float32)
@@ -50,21 +58,22 @@ class SingleAgentEnvRunner:
             self._key, sub = jax.random.split(self._key)
             actions, logps, values = self.module.forward_inference(
                 self.params, self.obs.astype(np.float32), sub)
+            if act_buf is None:
+                act_buf = np.empty((num_steps,) + actions.shape,
+                                   actions.dtype)
             obs_buf[t] = self.obs
             act_buf[t] = actions
             logp_buf[t] = logps
             val_buf[t] = values
-            for i, env in enumerate(self.envs):
-                nobs, rew, term, trunc, _ = env.step(int(actions[i]))
-                rew_buf[t, i] = rew
-                done = term or trunc
-                done_buf[t, i] = float(done)
-                self._ep_returns[i] += rew
-                if done:
-                    self._done_returns.append(self._ep_returns[i])
-                    self._ep_returns[i] = 0.0
-                    nobs, _ = env.reset()
-                self.obs[i] = nobs
+            nobs, rews, terms, truncs = self.vec.step_batch(actions)
+            rew_buf[t] = rews
+            dones = np.asarray(terms) | np.asarray(truncs)
+            done_buf[t] = dones.astype(np.float32)
+            self._ep_returns += rews
+            for i in np.where(dones)[0]:
+                self._done_returns.append(float(self._ep_returns[i]))
+                self._ep_returns[i] = 0.0
+            self.obs = np.asarray(nobs)
         self._key, sub = jax.random.split(self._key)
         _, _, last_vals = self.module.forward_inference(
             self.params, self.obs.astype(np.float32), sub)

@@ -90,8 +90,13 @@ class PPO:
         assert config._env_fn is not None, "call .environment(...) first"
         self.config = config
         probe = config._env_fn()
-        obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
+        if hasattr(probe, "obs_shape") and len(probe.obs_shape) == 3:
+            # Pixel env (H, W, C): RLModule picks the conv trunk.
+            obs_dim: Any = tuple(probe.obs_shape)
+            num_actions = int(probe.num_actions)
+        else:
+            obs_dim = int(np.prod(probe.observation_space.shape))
+            num_actions = int(probe.action_space.n)
         self.module = RLModule(obs_dim, num_actions, config.hidden)
         self.learner_group = LearnerGroup(
             self.module, config.learner, config.num_learners, config.seed)

@@ -29,14 +29,52 @@ class ActorCriticNet(nn.Module):
         return logits, value
 
 
-class RLModule:
-    """Discrete-action actor-critic module."""
+class ConvActorCriticNet(nn.Module):
+    """Pixel actor-critic: residual conv trunk (NHWC, the TPU-native conv
+    layout; norm-free residual blocks — running batch statistics don't
+    belong in an RL policy whose data distribution shifts every update) →
+    dense head. Sized for 84x84 observations at CPU-env-runner speeds."""
 
-    def __init__(self, obs_dim: int, num_actions: int,
-                 hidden: Sequence[int] = (64, 64)):
+    num_actions: int
+    channels: Sequence[int] = (16, 32, 32)
+    hidden: Sequence[int] = (256,)
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs.astype(jnp.float32)
+        x = nn.relu(nn.Conv(self.channels[0], (8, 8), strides=(4, 4),
+                            padding="SAME")(x))
+        for c in self.channels[1:]:
+            down = nn.Conv(c, (3, 3), strides=(2, 2), padding="SAME")(x)
+            y = nn.relu(nn.Conv(c, (3, 3), padding="SAME")(down))
+            x = nn.relu(down + nn.Conv(c, (3, 3), padding="SAME")(y))
+        x = x.reshape(x.shape[0], -1)
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        logits = nn.Dense(self.num_actions)(x)
+        value = nn.Dense(1)(x)[..., 0]
+        return logits, value
+
+
+class RLModule:
+    """Discrete-action actor-critic module.
+
+    obs_dim: int for flat observations (MLP trunk) or an (H, W, C) tuple
+    for pixels (conv trunk, reference: the Atari CNN stack)."""
+
+    def __init__(self, obs_dim, num_actions: int,
+                 hidden: Sequence[int] = (64, 64),
+                 conv_channels: Sequence[int] = (16, 32, 32)):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
-        self.net = ActorCriticNet(num_actions, tuple(hidden))
+        self.conv_channels = tuple(conv_channels)
+        if isinstance(obs_dim, (tuple, list)):
+            self._obs_shape = tuple(obs_dim)
+            self.net = ConvActorCriticNet(num_actions, self.conv_channels,
+                                          tuple(hidden))
+        else:
+            self._obs_shape = (int(obs_dim),)
+            self.net = ActorCriticNet(num_actions, tuple(hidden))
         self._fwd = jax.jit(
             lambda p, obs: self.net.apply({"params": p}, obs))
 
@@ -50,7 +88,8 @@ class RLModule:
         self._sample = jax.jit(sample_action)
 
     def init_params(self, rng: jax.Array):
-        return self.net.init(rng, jnp.zeros((1, self.obs_dim)))["params"]
+        return self.net.init(
+            rng, jnp.zeros((1,) + self._obs_shape))["params"]
 
     def forward_inference(self, params, obs: np.ndarray,
                           key) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -62,7 +101,8 @@ class RLModule:
 
     def __getstate__(self) -> Dict[str, Any]:
         return {"obs_dim": self.obs_dim, "num_actions": self.num_actions,
-                "hidden": tuple(self.net.hidden)}
+                "hidden": tuple(self.net.hidden),
+                "conv_channels": self.conv_channels}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__init__(**state)

@@ -1,0 +1,391 @@
+"""SAC — continuous-control soft actor-critic (reference:
+rllib/algorithms/sac/ — torch; here flax/optax, jitted, off-policy replay
+like dqn.py).
+
+Module: tanh-squashed Gaussian policy + twin Q networks + learned entropy
+temperature (alpha) against a target entropy of -|A| (the standard SAC
+recipe). One jitted update step trains policy, critics, and alpha together;
+target critics track by Polyak averaging inside the same step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+
+class GaussianPolicy(nn.Module):
+    act_dim: int
+    hidden: Tuple[int, ...] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs):
+        import jax.numpy as jnp
+
+        x = obs
+        for h in self.hidden:
+            x = nn.relu(nn.Dense(h)(x))
+        mean = nn.Dense(self.act_dim)(x)
+        # Tight upper clip: with tanh squashing, std beyond ~1.6 mostly
+        # saturates the action to +-1, collapsing exploration to the
+        # corners and starving the critics of interior-action data.
+        log_std = jnp.clip(nn.Dense(self.act_dim)(x), -5.0, 0.5)
+        return mean, log_std
+
+
+class TwinQ(nn.Module):
+    hidden: Tuple[int, ...] = (64, 64)
+
+    @nn.compact
+    def __call__(self, obs, act):
+        import jax.numpy as jnp
+
+        def q(name):
+            x = jnp.concatenate([obs, act], axis=-1)
+            for i, h in enumerate(self.hidden):
+                x = nn.relu(nn.Dense(h, name=f"{name}_d{i}")(x))
+            return nn.Dense(1, name=f"{name}_out")(x)[..., 0]
+
+        return q("q1"), q("q2")
+
+
+class SACModule:
+    """Runner-compatible module: forward_inference returns (action, logp,
+    value≡0) so SingleAgentEnvRunner's buffers work unchanged; actions are
+    float vectors in [-1, 1]^act_dim (scale in the env wrapper)."""
+
+    def __init__(self, obs_dim: int, act_dim: int,
+                 hidden: Tuple[int, ...] = (64, 64)):
+        import jax
+        import jax.numpy as jnp
+
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.hidden = tuple(hidden)
+        self.policy = GaussianPolicy(act_dim, self.hidden)
+        self.qnet = TwinQ(self.hidden)
+
+        def sample(params, obs, key):
+            mean, log_std = self.policy.apply({"params": params}, obs)
+            eps = jax.random.normal(key, mean.shape)
+            pre = mean + jnp.exp(log_std) * eps
+            act = jnp.tanh(pre)
+            logp = _tanh_gaussian_logp(pre, mean, log_std)
+            return act, logp, jnp.zeros((obs.shape[0],), jnp.float32)
+
+        self._sample = jax.jit(sample)
+
+    def init_params(self, rng):
+        import jax
+        import jax.numpy as jnp
+
+        k1, k2 = jax.random.split(rng)
+        obs = jnp.zeros((1, self.obs_dim))
+        return {
+            "policy": self.policy.init(k1, obs)["params"],
+            "q": self.qnet.init(k2, obs,
+                                jnp.zeros((1, self.act_dim)))["params"],
+        }
+
+    def forward_inference(self, weights, obs: np.ndarray, key):
+        a, logp, v = self._sample(weights, obs, key)
+        return np.asarray(a), np.asarray(logp), np.asarray(v)
+
+    def __getstate__(self):
+        return {"obs_dim": self.obs_dim, "act_dim": self.act_dim,
+                "hidden": self.hidden}
+
+    def __setstate__(self, state):
+        self.__init__(**state)
+
+
+def _tanh_gaussian_logp(pre, mean, log_std):
+    import jax
+    import jax.numpy as jnp
+
+    var = jnp.exp(2 * log_std)
+    base = -0.5 * ((pre - mean) ** 2 / var + 2 * log_std
+                   + jnp.log(2 * jnp.pi))
+    # Epsilon-bounded tanh change of variables (the standard SAC form):
+    # the exact 2(log2 - x - softplus(-2x)) correction is unbounded in
+    # |x|, which makes "drive the pre-activation to +-inf" a degenerate
+    # direction that farms -alpha*logp linearly and inflates the soft-Q
+    # targets; the epsilon floor caps that profit at ~13.8 nats/dim.
+    corr = jnp.log(1.0 - jnp.tanh(pre) ** 2 + 1e-6)
+    return (base + corr).sum(axis=-1)
+
+
+@dataclasses.dataclass
+class SACLearnerConfig:
+    lr: float = 3e-4
+    gamma: float = 0.99
+    tau: float = 0.01  # Polyak rate for target critics
+    batch_size: int = 128
+    sgd_steps_per_iter: int = 32
+    init_alpha: float = 0.02
+
+
+class SACLearner:
+    """One jitted step trains policy + critics + alpha and Polyak-updates
+    the target critics (all device-side; the host sees scalars)."""
+
+    def __init__(self, module: SACModule, config: SACLearnerConfig,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.module = module
+        self.cfg = config
+        params = module.init_params(jax.random.PRNGKey(seed))
+        self.state = {
+            "policy": params["policy"],
+            "q": params["q"],
+            "q_target": jax.tree.map(jnp.copy, params["q"]),
+            "log_alpha": jnp.asarray(np.log(config.init_alpha), jnp.float32),
+        }
+        self.opt = optax.chain(optax.clip_by_global_norm(10.0),
+                               optax.adam(config.lr))
+        self.opt_state = {
+            "policy": self.opt.init(self.state["policy"]),
+            "q": self.opt.init(self.state["q"]),
+            "alpha": self.opt.init(self.state["log_alpha"]),
+        }
+        target_entropy = -float(module.act_dim)
+        policy, qnet = module.policy, module.qnet
+        cfg = config
+        opt = self.opt
+
+        def q_loss(qp, state, mb, key):
+            mean, log_std = policy.apply({"params": state["policy"]},
+                                         mb["next_obs"])
+            eps = jax.random.normal(key, mean.shape)
+            pre = mean + jnp.exp(log_std) * eps
+            nact = jnp.tanh(pre)
+            nlogp = _tanh_gaussian_logp(pre, mean, log_std)
+            tq1, tq2 = qnet.apply({"params": state["q_target"]},
+                                  mb["next_obs"], nact)
+            alpha = jnp.exp(state["log_alpha"])
+            soft_q = jnp.minimum(tq1, tq2) - alpha * nlogp
+            target = mb["rewards"] + cfg.gamma * (1 - mb["dones"]) * \
+                jax.lax.stop_gradient(soft_q)
+            q1, q2 = qnet.apply({"params": qp}, mb["obs"], mb["actions"])
+            return ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+
+        def pi_loss(pp, state, mb, key):
+            mean, log_std = policy.apply({"params": pp}, mb["obs"])
+            eps = jax.random.normal(key, mean.shape)
+            pre = mean + jnp.exp(log_std) * eps
+            act = jnp.tanh(pre)
+            logp = _tanh_gaussian_logp(pre, mean, log_std)
+            q1, q2 = qnet.apply({"params": state["q"]}, mb["obs"], act)
+            alpha = jax.lax.stop_gradient(jnp.exp(state["log_alpha"]))
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp
+
+        def alpha_loss(log_alpha, logp):
+            return (-jnp.exp(log_alpha) *
+                    jax.lax.stop_gradient(logp + target_entropy)).mean()
+
+        def step(state, opt_state, mb, key):
+            k1, k2 = jax.random.split(key)
+            ql, qg = jax.value_and_grad(q_loss)(state["q"], state, mb, k1)
+            upd, opt_state["q"] = opt.update(qg, opt_state["q"], state["q"])
+            state["q"] = optax.apply_updates(state["q"], upd)
+            (pl, logp), pg = jax.value_and_grad(pi_loss, has_aux=True)(
+                state["policy"], state, mb, k2)
+            upd, opt_state["policy"] = opt.update(
+                pg, opt_state["policy"], state["policy"])
+            state["policy"] = optax.apply_updates(state["policy"], upd)
+            al, ag = jax.value_and_grad(alpha_loss)(
+                state["log_alpha"], logp)
+            upd, opt_state["alpha"] = opt.update(
+                ag, opt_state["alpha"], state["log_alpha"])
+            state["log_alpha"] = optax.apply_updates(
+                state["log_alpha"], upd)
+            state["q_target"] = jax.tree.map(
+                lambda t, o: t * (1 - cfg.tau) + o * cfg.tau,
+                state["q_target"], state["q"])
+            return state, opt_state, ql, pl, al
+
+        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._key = jax.random.PRNGKey(seed + 1)
+
+    def update(self, minibatches: List[Dict[str, np.ndarray]]
+               ) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        qls, pls = [], []
+        for mb in minibatches:
+            mb = {k: jnp.asarray(v) for k, v in mb.items()}
+            self._key, sub = jax.random.split(self._key)
+            self.state, self.opt_state, ql, pl, _ = self._step(
+                self.state, self.opt_state, mb, sub)
+            qls.append(float(ql))
+            pls.append(float(pl))
+        return {"q_loss": float(np.mean(qls)),
+                "pi_loss": float(np.mean(pls)),
+                "alpha": float(np.exp(self.state["log_alpha"])),
+                "sgd_steps": len(qls)}
+
+    def get_policy_weights(self):
+        import jax
+
+        return jax.device_get(self.state["policy"])
+
+
+class _SACReplay:
+    def __init__(self, capacity: int, obs_dim: int, act_dim: int):
+        self.capacity = capacity
+        self.obs = np.empty((capacity, obs_dim), np.float32)
+        self.next_obs = np.empty((capacity, obs_dim), np.float32)
+        self.actions = np.empty((capacity, act_dim), np.float32)
+        self.rewards = np.empty((capacity,), np.float32)
+        self.dones = np.empty((capacity,), np.float32)
+        self.size = 0
+        self._idx = 0
+
+    def add(self, obs, actions, rewards, next_obs, dones) -> None:
+        for i in range(obs.shape[0]):
+            j = self._idx
+            self.obs[j] = obs[i]
+            self.next_obs[j] = next_obs[i]
+            self.actions[j] = actions[i]
+            self.rewards[j] = rewards[i]
+            self.dones[j] = dones[i]
+            self._idx = (j + 1) % self.capacity
+            self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, n: int, rng) -> Dict[str, np.ndarray]:
+        idx = rng.integers(0, self.size, size=n)
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx],
+                "next_obs": self.next_obs[idx], "dones": self.dones[idx]}
+
+
+class SACConfig:
+    def __init__(self):
+        self._env_fn: Optional[Callable] = None
+        self.num_env_runners = 1
+        self.num_envs_per_runner = 4
+        self.rollout_length = 32
+        self.hidden = (64, 64)
+        self.seed = 0
+        self.buffer_capacity = 100_000
+        self.learn_start = 500
+        self.learner = SACLearnerConfig()
+
+    def environment(self, env_fn: Callable) -> "SACConfig":
+        self._env_fn = env_fn
+        return self
+
+    def env_runners(self, *, num_env_runners: int = 1,
+                    num_envs_per_env_runner: int = 4,
+                    rollout_fragment_length: int = 32) -> "SACConfig":
+        self.num_env_runners = num_env_runners
+        self.num_envs_per_runner = num_envs_per_env_runner
+        self.rollout_length = rollout_fragment_length
+        return self
+
+    def training(self, **overrides) -> "SACConfig":
+        for k, v in overrides.items():
+            if hasattr(self.learner, k):
+                setattr(self.learner, k, v)
+            elif k in ("buffer_capacity", "learn_start"):
+                setattr(self, k, int(v))
+            elif k == "model_hidden":
+                self.hidden = tuple(v)
+            else:
+                raise ValueError(f"unknown training option {k!r}")
+        return self
+
+    def debugging(self, *, seed: int = 0) -> "SACConfig":
+        self.seed = seed
+        return self
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC:
+    """training_step: sample (stochastic policy) → replay add → jitted SAC
+    updates → sync policy weights (reference: sac.py training_step)."""
+
+    def __init__(self, config: SACConfig):
+        assert config._env_fn is not None, "call .environment(...) first"
+        self.config = config
+        probe = config._env_fn()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        act_dim = int(np.prod(probe.action_space.shape))
+        self.obs_dim, self.act_dim = obs_dim, act_dim
+        self.module = SACModule(obs_dim, act_dim, config.hidden)
+        self.learner = SACLearner(self.module, config.learner, config.seed)
+        self.buffer = _SACReplay(config.buffer_capacity, obs_dim, act_dim)
+        self.env_runners = EnvRunnerGroup(
+            config._env_fn, self.module,
+            num_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_runner,
+            seed=config.seed)
+        self._rng = np.random.default_rng(config.seed)
+        self.env_steps = 0
+        self.iteration = 0
+        self._return_window: List[float] = []
+        self._sync()
+
+    def _sync(self) -> None:
+        self.env_runners.sync_weights(self.learner.get_policy_weights())
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        rollouts = self.env_runners.sample(cfg.rollout_length)
+        for r in rollouts:
+            obs, act = r["obs"], r["actions"]
+            T = obs.shape[0]
+            flat = lambda x: x[:T - 1].reshape((-1,) + x.shape[2:])
+            self.buffer.add(
+                flat(obs).reshape(-1, self.obs_dim),
+                flat(act).reshape(-1, self.act_dim),
+                flat(r["rewards"]).ravel(),
+                obs[1:].reshape(-1, self.obs_dim),
+                flat(r["dones"]).ravel())
+            self.env_steps += T * obs.shape[1]
+        result: Dict[str, Any] = {"q_loss": float("nan"),
+                                  "pi_loss": float("nan"), "sgd_steps": 0}
+        if self.buffer.size >= max(cfg.learn_start, cfg.learner.batch_size):
+            mbs = [self.buffer.sample(cfg.learner.batch_size, self._rng)
+                   for _ in range(cfg.learner.sgd_steps_per_iter)]
+            result = self.learner.update(mbs)
+        self._sync()
+        self._return_window.extend(self.env_runners.episode_returns())
+        self._return_window = self._return_window[-100:]
+        dt = time.perf_counter() - t0
+        steps = (cfg.rollout_length * cfg.num_envs_per_runner
+                 * cfg.num_env_runners)
+        return {
+            **result,
+            "env_steps_total": self.env_steps,
+            "env_steps_per_s": steps / dt,
+            "episode_return_mean": (float(np.mean(self._return_window))
+                                    if self._return_window
+                                    else float("nan")),
+        }
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        out = self.training_step()
+        out["training_iteration"] = self.iteration
+        return out
+
+    def get_weights(self):
+        return self.learner.get_policy_weights()
+
+    def stop(self) -> None:
+        pass

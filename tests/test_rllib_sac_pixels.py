@@ -1,0 +1,107 @@
+"""SAC (continuous control), vectorized env runners, and pixel-observation
+PPO learning (reference: rllib/algorithms/sac/, rllib/env/vector/, and the
+Atari-class pixel pipeline — here a procedural 84x84 gridworld through a
+residual conv trunk, no ROMs)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.examples.pixel_gridworld import PixelGridWorldBatch
+from ray_tpu.rllib.examples.point_goal import PointGoalEnv
+from ray_tpu.rllib.vector import SyncVectorEnv, as_batch_env
+
+
+def test_sync_vector_env_parity():
+    vec = SyncVectorEnv([lambda: PointGoalEnv(seed=1),
+                         lambda: PointGoalEnv(seed=2)], seed=7)
+    obs = vec.reset_all()
+    assert obs.shape == (2, 4)
+    nobs, rew, term, trunc = vec.step_batch(np.zeros((2, 2), np.float32))
+    assert nobs.shape == (2, 4) and rew.shape == (2,)
+    assert term.dtype == bool and trunc.dtype == bool
+
+
+def test_as_batch_env_passthrough_for_native_batch():
+    env = PixelGridWorldBatch(num_envs=3, size=5, res=40)
+    assert as_batch_env(lambda: env, num_envs=99) is env  # size respected
+
+
+def test_pixel_gridworld_batch_shapes_and_progress():
+    env = PixelGridWorldBatch(num_envs=4, size=5, res=40, seed=3)
+    obs = env.reset_all()
+    assert obs.shape == (4, 40, 40, 1)
+    assert float(obs.max()) == 1.0  # agent pixel rendered
+    obs2, rew, term, trunc = env.step_batch(np.zeros(4, np.int64))
+    assert obs2.shape == (4, 40, 40, 1)
+    assert rew.shape == (4,)
+
+
+def test_sac_learns_point_goal(ray_start_regular):
+    from ray_tpu.rllib import SACConfig
+
+    algo = (SACConfig()
+            .environment(lambda: PointGoalEnv())
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=8,
+                         rollout_fragment_length=40)
+            .training(batch_size=128, sgd_steps_per_iter=24,
+                      learn_start=300, lr=5e-4)
+            .debugging(seed=0)
+            .build())
+    first = None
+    best = -np.inf
+    for _ in range(25):
+        res = algo.train()
+        r = res["episode_return_mean"]
+        if not np.isnan(r):
+            first = r if first is None else first
+            best = max(best, r)
+    algo.stop()
+    assert first is not None
+    # random policy wanders (strongly negative return); a learning policy
+    # drives toward the goal
+    assert best > first + 3.0, (first, best)
+
+
+def test_ppo_learns_pixel_gridworld(ray_start_regular):
+    """84x84 pixel observations through the residual conv trunk: the
+    learning signal must appear within a short budget (improvement, not
+    convergence — this is the CPU test tier of BASELINE config 3)."""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment(env_fn=lambda: PixelGridWorldBatch(
+                num_envs=8, size=5, wall_density=0.1, max_steps=24,
+                res=84, seed=11))
+            .env_runners(num_env_runners=1, num_envs_per_env_runner=8,
+                         rollout_fragment_length=24)
+            .training(lr=1e-3, num_epochs=4, minibatch_size=64,
+                      entropy_coeff=0.01)
+            .debugging(seed=0)
+            .build())
+    returns = []
+    for _ in range(12):
+        res = algo.train()
+        r = res["episode_return_mean"]
+        if not np.isnan(r):
+            returns.append(r)
+    algo.stop()
+    assert returns, "no episodes completed"
+    early = np.mean(returns[:3])
+    late = np.mean(returns[-3:])
+    assert late > early + 0.1, (early, late)
+
+
+def test_env_throughput_batch_vs_loop():
+    """The natively-batched pixel env steps much faster than a per-env
+    python loop at the same batch size (the point of vectorization)."""
+    import time
+
+    env = PixelGridWorldBatch(num_envs=16, size=7, res=84, seed=5)
+    env.reset_all()
+    acts = np.random.default_rng(0).integers(0, 4, size=(50, 16))
+    t0 = time.perf_counter()
+    for t in range(50):
+        env.step_batch(acts[t])
+    batch_sps = 50 * 16 / (time.perf_counter() - t0)
+    assert batch_sps > 2000, batch_sps  # array-op stepping is cheap
