@@ -225,6 +225,12 @@ def main():
                   f"({dag['dag_vs_ref_chain']}x vs hand-written ref chain, "
                   f"{dag['dag_vs_stop_and_go']}x vs stop-and-go)",
                   file=sys.stderr)
+            from ray_tpu.benchmarks.dag_bench import run_diamond_bench
+
+            dia = run_diamond_bench(ray_tpu, n=150)
+            print(f"dag_diamond: channels {dia['diamond_channels_per_s']}/s "
+                  f"vs actor-push {dia['diamond_actor_push_per_s']}/s "
+                  f"({dia['diamond_speedup']}x)", file=sys.stderr)
         except Exception as e:
             print(f"dag bench skipped: {type(e).__name__}: {e}",
                   file=sys.stderr)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import concurrent.futures
 import os
+import sys
 import threading
 import time
 import traceback
@@ -759,10 +760,50 @@ class Worker:
         s.register("ping", self._rpc_ping)
         s.register("fast_lane_info", self._rpc_fast_lane_info)
         s.register("dag_method_info", self._rpc_dag_method_info)
+        s.register("dump_stacks", self._rpc_dump_stacks)
         s.register("device_object_fetch", self._rpc_device_object_fetch)
         s.register("device_object_fetch_shm", self._rpc_device_object_fetch_shm)
         s.register("device_object_mesh_send", self._rpc_device_object_mesh_send)
         s.register("device_object_free", self._rpc_device_object_free)
+        s.register("dag_channel_push", self._rpc_dag_channel_push)
+        s.register("dag_channel_close", self._rpc_dag_channel_close)
+        s.register("dag_channel_destroy", self._rpc_dag_channel_destroy)
+        s.register("dag_channel_close_shm", self._rpc_dag_channel_close_shm)
+
+    async def _rpc_dump_stacks(self) -> Dict[str, Any]:
+        """All-thread python stacks of this worker (reference: the
+        dashboard agent's py-spy stack-dump endpoint,
+        dashboard/modules/reporter/ — here native sys._current_frames,
+        which needs no ptrace and works on any worker)."""
+        import traceback
+
+        frames = sys._current_frames()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        stacks = {}
+        for ident, frame in frames.items():
+            label = f"{names.get(ident, '?')} ({ident})"
+            stacks[label] = "".join(traceback.format_stack(frame))
+        return {"pid": os.getpid(), "stacks": stacks}
+
+    async def _rpc_dag_channel_push(self, key: str, payload) -> Dict[str, Any]:
+        from ray_tpu.experimental.channel import rpc_channel
+
+        return await rpc_channel.rpc_push(self, key, payload)
+
+    async def _rpc_dag_channel_close(self, key: str) -> Dict[str, Any]:
+        from ray_tpu.experimental.channel import rpc_channel
+
+        return await rpc_channel.rpc_close(self, key)
+
+    async def _rpc_dag_channel_destroy(self, key: str) -> Dict[str, Any]:
+        from ray_tpu.experimental.channel import rpc_channel
+
+        return await rpc_channel.rpc_destroy(self, key)
+
+    async def _rpc_dag_channel_close_shm(self, path: str) -> Dict[str, Any]:
+        from ray_tpu.experimental.channel import rpc_channel
+
+        return await rpc_channel.rpc_close_shm(self, path)
 
     async def _rpc_device_object_fetch(self, object_id: bytes) -> Dict[str, Any]:
         from ray_tpu.experimental import device_objects as devobj
@@ -2005,47 +2046,75 @@ class Worker:
                 "is_async": bool(m is not None
                                  and asyncio.iscoroutinefunction(m))}
 
-    def _dag_channel_loop(self, in_path: str, out_path: str,
+    def _dag_channel_loop(self, in_descs: List[Dict[str, Any]],
+                          out_descs: List[Dict[str, Any]],
                           method_name: str) -> str:
         """Pinned compiled-DAG stage loop (reference: aDAG's per-actor
-        execution loops, dag/compiled_dag_node.py): read the input shm
-        channel, run the method, write the output channel — zero RPCs per
-        item. Exits when the input channel closes (dag.teardown). Runs on
-        an executor thread; the per-item exec lock keeps max_concurrency=1
+        execution loops, dag/compiled_dag_node.py): read one value per
+        input channel (fan-in, arg order), run the method, write the
+        result to every output channel (fan-out) — zero control-plane RPCs
+        per item on same-host edges; cross-host edges ride RpcChannels.
+        Exits when any input channel closes (dag.teardown). Runs on an
+        executor thread; the per-item exec lock keeps max_concurrency=1
         semantics against fast-lane calls."""
         from ray_tpu.dag import _DagChannelError
-        from ray_tpu.experimental.channel import ShmChannel
+        from ray_tpu.experimental.channel import rpc_channel
         from ray_tpu.experimental.channel.shm_channel import ChannelClosed
 
-        cin = ShmChannel(in_path)
-        cout = ShmChannel(out_path)
+        ins = [rpc_channel.open_reader(self, d) for d in in_descs]
+        outs = [rpc_channel.open_writer(self, d) for d in out_descs]
         lock = getattr(self, "_actor_exec_lock", None)
         method = getattr(self._actor_instance, method_name)
         try:
             while True:
                 try:
-                    value = cin.read()
+                    values = [c.read() for c in ins]
                 except ChannelClosed:
                     return "closed"
                 try:
-                    if isinstance(value, _DagChannelError):
-                        out: Any = value  # upstream failed: propagate
+                    err = next((v for v in values
+                                if isinstance(v, _DagChannelError)), None)
+                    if err is not None:
+                        out: Any = err  # upstream failed: propagate
                     elif lock is not None:
                         with lock:
-                            out = method(value)
+                            out = method(*values)
                     else:
-                        out = method(value)
+                        out = method(*values)
                 except BaseException as e:  # noqa: BLE001
                     out = _DagChannelError(e)
-                try:
-                    cout.write(out)
-                except Exception as e:  # noqa: BLE001
-                    # Unserializable / slot-overflow result: surface the
-                    # real cause downstream instead of dying with an
-                    # opaque ChannelClosed.
-                    cout.write(_DagChannelError(e))
+                payload = None
+                for c in outs:
+                    try:
+                        if payload is None:
+                            payload = c.encode(out)  # once per item,
+                            # however many consumers (fan-out)
+                        c.write_payload(payload)
+                    except ChannelClosed:
+                        return "closed"
+                    except Exception as e:  # noqa: BLE001
+                        # Unserializable / slot-overflow result: surface
+                        # the real cause downstream instead of dying with
+                        # an opaque ChannelClosed.
+                        c.write(_DagChannelError(e))
         finally:
-            cout.close()
+            for c in outs:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+                try:
+                    c.destroy()  # rpc writers: drop registry + client
+                except Exception:
+                    pass
+            for c in ins:
+                try:
+                    # destroy: shm in-channels are this loop's to unlink
+                    # (their reader created them); rpc readers just close
+                    # and drop their registry entry.
+                    c.destroy()
+                except Exception:
+                    pass
 
     async def _rpc_push_actor_task_batch(self, specs: List[bytes]) -> Dict[str, Any]:
         """Execute a batch of actor tasks. Runs of consecutive sync methods

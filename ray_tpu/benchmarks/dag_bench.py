@@ -70,3 +70,45 @@ def run_dag_bench(ray_tpu, n: int = 300, payload_bytes: int = 1024
         "dag_vs_ref_chain": round(dag_rate / ref_chain, 3),
         "dag_vs_stop_and_go": round(dag_rate / stop_and_go, 3),
     }
+
+
+def run_diamond_bench(ray_tpu, n: int = 200) -> Dict[str, Any]:
+    """Branching graph: input → a → (b, c) → d on channels vs the same
+    graph replayed via actor pushes (VERDICT r3 #10 Done criterion)."""
+    from ray_tpu.dag import CompiledDAG, InputNode
+
+    @ray_tpu.remote
+    class Stage:
+        def one(self, x):
+            return x + 1
+
+        def join(self, p, q):
+            return p + q
+
+    a, b, c, d = (Stage.remote() for _ in range(4))
+    ray_tpu.get([s.one.remote(0) for s in (a, b, c, d)])
+
+    def build():
+        with InputNode() as inp:
+            mid = a.one.bind(inp)
+            return d.join.bind(b.one.bind(mid), c.one.bind(mid))
+
+    rates = {}
+    for label, kwargs in (("channels", {}),
+                          ("actor_push", {"enable_channels": False})):
+        dag = CompiledDAG(build(), **kwargs)
+        for i in range(8):
+            ray_tpu.get(dag.execute(i))
+        t0 = time.perf_counter()
+        refs = [dag.execute(i) for i in range(n)]
+        for r in refs:
+            ray_tpu.get(r)
+        rates[label] = n / (time.perf_counter() - t0)
+        dag.teardown()
+    for s in (a, b, c, d):
+        ray_tpu.kill(s)
+    return {
+        "diamond_channels_per_s": round(rates["channels"], 1),
+        "diamond_actor_push_per_s": round(rates["actor_push"], 1),
+        "diamond_speedup": round(rates["channels"] / rates["actor_push"], 2),
+    }

@@ -805,6 +805,64 @@ class Nodelet:
         return "pong"
 
     # ------------------------------------------------------------------
+    # Profiling / debugging endpoints (reference: the per-node dashboard
+    # agent's reporter module — py-spy stack dumps and psutil process
+    # stats, dashboard/modules/reporter/; here native: sys._current_frames
+    # in-worker and /proc sampling here)
+    # ------------------------------------------------------------------
+    async def rpc_node_stacks(self) -> Dict[str, Any]:
+        """All-thread python stacks for every live worker on this node,
+        gathered concurrently (the `ray stack` surface)."""
+
+        async def _one(wid, w):
+            client = None
+            try:
+                client = RpcClient(*w.address, name="stacks")
+                return wid.hex()[:12], await client.call(
+                    "dump_stacks", timeout=10)
+            except Exception as e:  # noqa: BLE001
+                return wid.hex()[:12], {"error": repr(e)}
+            finally:
+                if client is not None:
+                    try:
+                        await client.close()
+                    except Exception:
+                        pass
+
+        pairs = await asyncio.gather(
+            *[_one(wid, w) for wid, w in list(self.workers.items())
+              if w.proc.poll() is None and w.address is not None])
+        return {"node": self.node_name, "workers": dict(pairs)}
+
+    async def rpc_node_proc_stats(self) -> Dict[str, Any]:
+        """Per-worker process stats from /proc (cpu seconds, rss, threads)
+        plus the nodelet's own — the reporter-agent metrics floor."""
+        out: Dict[str, Any] = {"node": self.node_name, "procs": {}}
+        pids = {"nodelet": os.getpid()}
+        for wid, w in list(self.workers.items()):
+            if w.proc.poll() is None:
+                pids[wid.hex()[:12]] = w.proc.pid
+        page = os.sysconf("SC_PAGE_SIZE")
+        tick = os.sysconf("SC_CLK_TCK")
+        for label, pid in pids.items():
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    parts = f.read().rsplit(")", 1)[1].split()
+                utime, stime = int(parts[11]), int(parts[12])
+                threads = int(parts[17])
+                with open(f"/proc/{pid}/statm") as f:
+                    rss_pages = int(f.read().split()[1])
+                out["procs"][label] = {
+                    "pid": pid,
+                    "cpu_seconds": round((utime + stime) / tick, 2),
+                    "rss_mb": round(rss_pages * page / 2**20, 1),
+                    "num_threads": threads,
+                }
+            except OSError:
+                pass
+        return out
+
+    # ------------------------------------------------------------------
     # Background loops
     # ------------------------------------------------------------------
     def _record_unmet_demand(self, resources: Dict[str, float]) -> None:

@@ -24,10 +24,13 @@ per submitter.
 from __future__ import annotations
 
 import itertools
+import logging
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+
+logger = logging.getLogger(__name__)
 
 
 class DAGNode:
@@ -92,19 +95,22 @@ class MultiOutputNode(DAGNode):
 class CompiledDAGRef:
     """Handle to one channel-mode execution's output (reference:
     CompiledDAGRef, dag/compiled_dag_node.py). `ray_tpu.get` accepts it
-    (single or in lists)."""
+    (single or in lists). `chan` picks the output channel for
+    MultiOutputNode graphs."""
 
-    __slots__ = ("_dag", "_seq", "_value", "_done")
+    __slots__ = ("_dag", "_seq", "_chan", "_value", "_done")
 
-    def __init__(self, dag: "CompiledDAG", seq: int):
+    def __init__(self, dag: "CompiledDAG", seq: int, chan: int = 0):
         self._dag = dag
         self._seq = seq
+        self._chan = chan
         self._value = None
         self._done = False
 
     def get(self, timeout: Optional[float] = None):
         if not self._done:
-            self._value = self._dag._collect_output(self._seq, timeout)
+            self._value = self._dag._collect_output(
+                self._seq, timeout, self._chan)
             self._done = True
         if isinstance(self._value, _DagChannelError):
             raise self._value.rebuild()
@@ -159,15 +165,21 @@ class CompiledDAG:
         self._loop_refs: List[Any] = []
         self._stage_error: Optional[BaseException] = None
         self._exec_seq = 0
-        self._next_out_seq = 0
-        self._out_buffer: Dict[int, Any] = {}
+        self._input_writers: List[Any] = []
+        self._out_readers: List[Any] = []
+        self._next_out_seq: List[int] = []
+        self._out_buffer: List[Dict[int, Any]] = []
         self._inflight: List[CompiledDAGRef] = []
         self._channel_mode = False
-        if enable_channels and self._is_linear_local_chain():
+        if enable_channels and self._channels_supported():
             try:
                 self._setup_channels()
+                self._next_out_seq = [0] * len(self._out_readers)
+                self._out_buffer = [{} for _ in self._out_readers]
                 self._channel_mode = True
             except Exception:
+                logger.warning("compiled-DAG channel setup failed; "
+                               "falling back to actor-push", exc_info=True)
                 self._teardown_channels()
 
     def _walk(self, node: DAGNode) -> None:
@@ -185,68 +197,132 @@ class CompiledDAG:
     # ------------------------------------------------------------------
     # Channel fast path
     # ------------------------------------------------------------------
-    def _is_linear_local_chain(self) -> bool:
-        """Channel mode preconditions: single input, each stage consumes
-        exactly the previous stage (or the input) as its only arg, distinct
-        actors, no device transport, plain (non-Multi) output."""
-        if isinstance(self._output, MultiOutputNode):
-            return False
+    def _channels_supported(self) -> bool:
+        """Channel-mode preconditions for ARBITRARY graphs: single input
+        node, every stage arg is a DAG node (fan-in allowed), every node
+        used by >=1 consumer or the output (fan-out allowed), distinct
+        actors, no kwargs/device transport. Cross-host edges are fine —
+        they ride RpcChannels."""
         if len(self._input_nodes) != 1 or not self._order:
             return False
-        prev: DAGNode = self._input_nodes[0]
         seen_actors = set()
         for node in self._order:
-            if node._tensor_transport:
+            if node._tensor_transport or node.kwargs:
                 return False
-            if len(node.args) != 1 or node.kwargs:
-                return False
-            if node.args[0] is not prev:
+            if not node.args or any(not isinstance(a, DAGNode)
+                                    for a in node.args):
                 return False
             aid = node.actor_handle._actor_id
             if aid in seen_actors:
                 return False
             seen_actors.add(aid)
-            prev = node
-        return prev is self._output
+        outs = (self._output.outputs
+                if isinstance(self._output, MultiOutputNode)
+                else [self._output])
+        return all(isinstance(o, ClassMethodNode) for o in outs)
 
     def _setup_channels(self) -> None:
         import os
         import uuid
 
         from ray_tpu._private import worker as worker_mod
-        from ray_tpu.experimental.channel import ShmChannel
+        from ray_tpu.experimental.channel import rpc_channel
 
         w = worker_mod.global_worker()
-        # Same-filesystem requirement: every actor must live on this host
-        # (cluster_utils multi-"node" on one machine still qualifies).
         my_host = w.address[0]
+        addr_of: Dict[int, Tuple[str, int]] = {}
         for node in self._order:
             info = w.loop_thread.run(
                 w.actor_state(node.actor_handle._actor_id, refresh=True))
             if (not info or info.get("state") != "ALIVE"
-                    or not info.get("address")
-                    or info["address"][0] != my_host):
-                raise RuntimeError("actor not local; channel mode off")
+                    or not info.get("address")):
+                raise RuntimeError("actor not alive; channel mode off")
+            addr_of[id(node)] = tuple(info["address"])
             # The pinned loop is synchronous — an async method would come
             # back as an un-awaited coroutine. Probe the live instance.
-            minfo = self._probe_method(w, tuple(info["address"]),
+            minfo = self._probe_method(w, addr_of[id(node)],
                                        node.method_name)
             if not minfo.get("exists") or minfo.get("is_async"):
                 raise RuntimeError(
                     f"method {node.method_name!r} missing or async; "
                     "channel mode off")
-        base = os.path.join("/dev/shm",
-                            f"ray_tpu_dag_{uuid.uuid4().hex[:12]}")
-        n = len(self._order)
-        self._channels = [
-            ShmChannel(f"{base}_{i}", create=True) for i in range(n + 1)]
+
+        base = f"ray_tpu_dag_{uuid.uuid4().hex[:12]}"
+        counter = itertools.count()
+        # Test hook: exercise the cross-host channel kind on one machine.
+        force_rpc = os.environ.get(
+            "RAY_TPU_DAG_FORCE_RPC_CHANNELS") == "1"
+
+        def edge_desc(src_host: str, dst_host: str) -> Dict[str, Any]:
+            i = next(counter)
+            if src_host == dst_host and not force_rpc:
+                return {"kind": "shm",
+                        "path": os.path.join("/dev/shm", f"{base}_{i}"),
+                        "slots": 8}
+            return {"kind": "rpc", "key": f"{base}_{i}", "slots": 8}
+
+        # Edges: per consumer-arg (fan-in) and per consumed-value
+        # consumer (fan-out). The READER of each edge creates it.
+        node_in_descs: Dict[int, List[Dict[str, Any]]] = {
+            id(n): [] for n in self._order}
+        node_out_descs: Dict[int, List[Dict[str, Any]]] = {
+            id(n): [] for n in self._order}
+        self._input_writers_descs: List[Dict[str, Any]] = []
+        out_nodes = (self._output.outputs
+                     if isinstance(self._output, MultiOutputNode)
+                     else [self._output])
+        for node in self._order:
+            dst_addr = addr_of[id(node)]
+            for a in node.args:
+                src_host = (my_host if isinstance(a, InputNode)
+                            else addr_of[id(a)][0])
+                desc = edge_desc(src_host, dst_addr[0])
+                # Reader's worker address rides on EVERY desc: rpc edges
+                # dial it for pushes; remote shm edges need it so the
+                # driver can poison-close a ring on another host's fs.
+                desc["addr"] = list(dst_addr)
+                if isinstance(a, InputNode):
+                    self._input_writers_descs.append(desc)
+                else:
+                    node_out_descs[id(a)].append(desc)
+                node_in_descs[id(node)].append(
+                    {**desc, "create": desc["kind"] == "shm"})
+        # Output edges: the driver reads them (and creates the shm ones).
+        self._out_readers = []
+        self._out_reader_descs = []
+        for t in out_nodes:
+            desc = edge_desc(addr_of[id(t)][0], my_host)
+            if desc["kind"] == "rpc":
+                desc = {**desc, "addr": list(w.address)}
+            node_out_descs[id(t)].append(desc)
+            rdesc = {**desc, "create": desc["kind"] == "shm"}
+            self._out_reader_descs.append(rdesc)
+            reader = rpc_channel.open_reader(w, rdesc)
+            self._out_readers.append(reader)
+            self._channels.append(reader)  # incrementally: a failure
+            # ANYWHERE below must still tear these down
+
+        # Every edge the driver knows about, with enough to close it from
+        # here: a dead/stuck stage must not leave sibling loops blocked on
+        # rings only that stage would have drained.
+        self._all_edge_descs = (
+            [dict(d) for d in self._input_writers_descs]
+            + [dict(d) for descs in node_in_descs.values() for d in descs])
+
         self._loop_refs = []
-        for i, node in enumerate(self._order):
+        for node in self._order:
             method = getattr(node.actor_handle, "__dag_channel_loop__")
             self._loop_refs.append(method.remote(
-                in_path=self._channels[i].path,
-                out_path=self._channels[i + 1].path,
+                in_descs=node_in_descs[id(node)],
+                out_descs=node_out_descs[id(node)],
                 method_name=node.method_name))
+        # Driver-side input writers (shm readers are the stage loops; wait
+        # for them to create the files).
+        self._input_writers = []
+        for d in self._input_writers_descs:
+            wtr = rpc_channel.open_writer(w, d)
+            self._input_writers.append(wtr)
+            self._channels.append(wtr)
 
     @staticmethod
     def _probe_method(w, address: Tuple[str, int],
@@ -287,8 +363,11 @@ class CompiledDAG:
         except BaseException as e:  # noqa: BLE001
             err = e
         self._stage_error = err
-        # Close every channel: blocked pinned loops and readers unblock
-        # with ChannelClosed instead of waiting forever.
+        # Close EVERY edge (not just driver-owned endpoints): blocked
+        # pinned loops — including siblings of the dead stage stuck on
+        # rings nobody will drain — unblock with ChannelClosed instead of
+        # waiting forever.
+        self._close_all_edges()
         for ch in self._channels:
             try:
                 ch.close()
@@ -296,23 +375,79 @@ class CompiledDAG:
                 pass
         raise err
 
-    def _collect_output(self, seq: int, timeout: Optional[float] = None):
-        """Outputs arrive strictly in execute() order on the last channel;
-        buffer values for refs resolved out of order. Reads run in bounded
-        slices with a stage-liveness check between them, so a dead stage
-        actor surfaces as ActorDiedError rather than a hang."""
+    def _close_all_edges(self) -> None:
+        """Best-effort close of every channel edge in the graph from the
+        driver: same-host shm flags flip directly; remote edges (rpc
+        rings, and shm rings on ANOTHER host's /dev/shm) get a close RPC
+        to the reader's worker — grouped one connection per worker
+        address. Safe to call repeatedly."""
+        import os
+
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.experimental.channel import ShmChannel
+
+        w = worker_mod.global_worker()
+        my_host = w.address[0]
+        remote: Dict[Tuple[str, int], List[Tuple[str, str]]] = {}
+        for d in getattr(self, "_all_edge_descs", []):
+            try:
+                if d["kind"] == "shm" and (d["addr"][0] == my_host
+                                           or "addr" not in d):
+                    if os.path.exists(d["path"]):
+                        ShmChannel(d["path"]).close()
+                elif d["kind"] == "shm":
+                    remote.setdefault(tuple(d["addr"]), []).append(
+                        ("dag_channel_close_shm", d["path"]))
+                else:
+                    remote.setdefault(tuple(d["addr"]), []).append(
+                        ("dag_channel_close", d["key"]))
+            except Exception:
+                pass
+
+        async def _close_remote():
+            from ray_tpu._private.rpc import RpcClient
+
+            for addr, items in remote.items():
+                c = RpcClient(*addr, name="dag-close")
+                try:
+                    for method, ident in items:
+                        kw = ({"path": ident}
+                              if method == "dag_channel_close_shm"
+                              else {"key": ident})
+                        await c.call(method, timeout=5, **kw)
+                except Exception:
+                    pass  # reader's worker already gone: nothing to close
+                finally:
+                    try:
+                        await c.close()
+                    except Exception:
+                        pass
+
+        if remote:
+            try:
+                w.loop_thread.run(_close_remote())
+            except Exception:
+                pass
+
+    def _collect_output(self, seq: int, timeout: Optional[float] = None,
+                        chan: int = 0):
+        """Outputs arrive strictly in execute() order on each output
+        channel; buffer values for refs resolved out of order. Reads run
+        in bounded slices with a stage-liveness check between them, so a
+        dead stage actor surfaces as ActorDiedError rather than a hang."""
         from ray_tpu.experimental.channel import ChannelClosed
 
         deadline = (None if timeout is None
                     else time.monotonic() + timeout)
-        while seq not in self._out_buffer:
+        buf = self._out_buffer[chan]
+        while seq not in buf:
             if self._stage_error is not None:
                 raise self._stage_error
             slice_t = 0.2
             if deadline is not None:
                 slice_t = min(slice_t, max(0.0, deadline - time.monotonic()))
             try:
-                value = self._channels[-1].read(slice_t)
+                value = self._out_readers[chan].read(slice_t)
             except TimeoutError:
                 if deadline is not None and time.monotonic() >= deadline:
                     raise
@@ -321,12 +456,14 @@ class CompiledDAG:
             except ChannelClosed:
                 self._check_stage_liveness()
                 raise
-            self._out_buffer[self._next_out_seq] = value
-            self._next_out_seq += 1
-        self._inflight = [r for r in self._inflight if r._seq != seq]
-        return self._out_buffer.pop(seq)
+            buf[self._next_out_seq[chan]] = value
+            self._next_out_seq[chan] += 1
+        self._inflight = [r for r in self._inflight
+                          if not (r._seq == seq and r._chan == chan)]
+        return buf.pop(seq)
 
     def _teardown_channels(self) -> None:
+        self._close_all_edges()
         for ch in self._channels:
             try:
                 ch.close()
@@ -338,6 +475,8 @@ class CompiledDAG:
             except Exception:
                 pass
         self._channels = []
+        self._input_writers = []
+        self._out_readers = []
         self._loop_refs = []
         self._channel_mode = False
 
@@ -359,33 +498,44 @@ class CompiledDAG:
             # in-flight window by draining the OLDEST ref when full (its
             # error, if any, stays cached on that ref — it must not poison
             # this execution).
-            limit = max(1, self._channels[0].nslots - 1)
-            while len(self._inflight) >= limit:
-                # Pop BEFORE get(): if the channel is closed (stage death),
-                # get() raises without touching _inflight and this loop
-                # must still make progress.
-                oldest = self._inflight.pop(0)
-                try:
-                    oldest.get()
-                except Exception:  # noqa: BLE001
-                    pass
+            limit = max(1, min(wtr.nslots for wtr in self._input_writers)
+                        - 1)
+            n_out = len(self._out_readers)
+            # Bound by distinct in-flight EXECUTIONS (not refs): with
+            # multiple outputs, counting refs would admit more sequences
+            # than the narrowest ring buffers and stall the input write.
+            while len({r._seq for r in self._inflight}) >= limit:
+                oldest_seq = min(r._seq for r in self._inflight)
+                for r in [r for r in self._inflight
+                          if r._seq == oldest_seq]:
+                    try:
+                        r.get()  # drains and removes itself from inflight
+                    except Exception:  # noqa: BLE001
+                        pass
+                # Defensive: a ref whose get() raised without removal
+                # (stage death) must not wedge this loop.
+                self._inflight = [r for r in self._inflight
+                                  if r._seq != oldest_seq]
             # Sliced write + liveness check: a dead middle stage stalls
             # the ring and must surface, not block for the full timeout.
             # Encode once; only the ring-slot claim is retried.
-            payload = self._channels[0].encode(input_val)
-            wr_deadline = time.monotonic() + 600.0
-            while True:
-                try:
-                    self._channels[0].write_payload(payload, timeout=0.2)
-                    break
-                except TimeoutError:
-                    if time.monotonic() >= wr_deadline:
-                        raise
-                    self._check_stage_liveness()
-            ref = CompiledDAGRef(self, self._exec_seq)
+            payload = self._input_writers[0].encode(input_val)
+            for wtr in self._input_writers:
+                wr_deadline = time.monotonic() + 600.0
+                while True:
+                    try:
+                        wtr.write_payload(payload, timeout=0.2)
+                        break
+                    except TimeoutError:
+                        if time.monotonic() >= wr_deadline:
+                            raise
+                        self._check_stage_liveness()
+            refs = tuple(CompiledDAGRef(self, self._exec_seq, c)
+                         for c in range(n_out))
             self._exec_seq += 1
-            self._inflight.append(ref)
-            return ref
+            self._inflight.extend(refs)
+            return refs if isinstance(self._output, MultiOutputNode) \
+                else refs[0]
         results: Dict[int, Any] = {}
 
         def resolve(a):
@@ -412,7 +562,7 @@ class CompiledDAG:
     def teardown(self) -> None:
         if self._channel_mode:
             self._inflight = []
-            self._out_buffer.clear()
+            self._out_buffer = []
             self._teardown_channels()
         self._order.clear()
         self._visited.clear()

@@ -98,6 +98,10 @@ class DashboardActor:
             "/api/jobs": state.list_jobs,
             "/api/placement_groups": state.list_placement_groups,
             "/api/tasks": state.list_tasks,
+            # Reporter-agent surfaces (reference: dashboard/modules/
+            # reporter/ — stack dumps + process stats per node).
+            "/api/stacks": state.stack_dump,
+            "/api/proc_stats": state.node_proc_stats,
         }
         fn = table.get(path.rstrip("/"))
         if fn is None:

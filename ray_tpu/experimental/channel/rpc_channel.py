@@ -1,0 +1,280 @@
+"""Cross-host compiled-DAG channel over the worker RPC plane (reference:
+the remote path of aDAG's shared-memory channels — shared_memory_channel.py
+backed by the object transfer plane; here a direct push stream).
+
+Writer side: values push to the CONSUMER worker's RPC server as pickle-5
+out-of-band payloads, with a bounded in-flight window (the reply is the
+ack, so backpressure is end-to-end). Reader side: the consumer worker's
+push handler feeds a per-key queue; the pinned DAG loop thread pops it.
+Close mirrors ShmChannel: a closed channel raises ChannelClosed once
+drained.
+"""
+
+from __future__ import annotations
+
+import collections
+import pickle
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.experimental.channel.shm_channel import ChannelClosed, ShmChannel
+
+
+class _RpcChanState:
+    """Registry entry living in the consumer worker."""
+
+    __slots__ = ("queue", "cond", "closed", "slots")
+
+    def __init__(self, slots: int = 8):
+        self.queue: collections.deque = collections.deque()
+        self.cond = threading.Condition()
+        self.closed = False
+        self.slots = slots
+
+
+def registry(worker) -> Dict[str, _RpcChanState]:
+    reg = getattr(worker, "_dag_rpc_channels", None)
+    if reg is None:
+        reg = worker._dag_rpc_channels = {}
+    return reg
+
+
+def _tombstones(worker):
+    ts = getattr(worker, "_dag_rpc_tombstones", None)
+    if ts is None:
+        ts = worker._dag_rpc_tombstones = collections.OrderedDict()
+    return ts
+
+
+def get_or_create(worker, key: str, slots: int = 8) -> _RpcChanState:
+    reg = registry(worker)
+    st = reg.get(key)
+    if st is None:
+        st = reg[key] = _RpcChanState(slots)
+    return st
+
+
+# ---------------------------------------------------------------------------
+# Worker RPC handlers (wired in _private/worker.py)
+# ---------------------------------------------------------------------------
+
+async def rpc_push(worker, key: str, payload) -> Dict[str, Any]:
+    import asyncio
+
+    if key in _tombstones(worker):
+        return {"closed": True}  # destroyed: a straggler push must not
+        # resurrect the entry and strand its payload
+    st = get_or_create(worker, key)
+    while True:
+        with st.cond:
+            if st.closed:
+                return {"closed": True}
+            if len(st.queue) < st.slots:
+                st.queue.append(bytes(payload) if not isinstance(
+                    payload, (bytes, bytearray)) else payload)
+                st.cond.notify_all()
+                return {"ok": True}
+        # Ring full: the delayed reply IS the writer's backpressure.
+        await asyncio.sleep(0.002)
+
+
+async def rpc_close(worker, key: str) -> Dict[str, Any]:
+    st = registry(worker).get(key)
+    if st is None:
+        return {"ok": True}  # never opened or already destroyed: done —
+        # creating an entry here would leak a zombie after teardown races
+    with st.cond:
+        st.closed = True
+        st.cond.notify_all()
+    return {"ok": True}
+
+
+async def rpc_destroy(worker, key: str) -> Dict[str, Any]:
+    st = registry(worker).pop(key, None)
+    if st is not None:
+        with st.cond:
+            st.closed = True
+            st.cond.notify_all()
+    ts = _tombstones(worker)
+    ts[key] = True
+    while len(ts) > 512:  # bounded memory of recent teardowns
+        ts.popitem(last=False)
+    return {"ok": True}
+
+
+async def rpc_close_shm(worker, path: str) -> Dict[str, Any]:
+    """Flip a LOCAL shm channel's closed flag on behalf of a remote
+    driver: an actor-to-actor shm edge on this host is invisible to a
+    driver on another host, but its poison-close must still land
+    (dag/__init__.py _close_all_edges)."""
+    import os
+
+    if os.path.exists(path):
+        try:
+            ShmChannel(path).close()
+        except Exception:
+            pass
+    return {"ok": True}
+
+
+# ---------------------------------------------------------------------------
+# Endpoints (used from pinned DAG loop threads and the driver)
+# ---------------------------------------------------------------------------
+
+class RpcChannelReader:
+    """Pops the local registry queue this worker's push handler feeds."""
+
+    def __init__(self, worker, key: str, slots: int = 8):
+        self._worker = worker
+        self._key = key
+        self._st = get_or_create(worker, key, slots)
+        self.nslots = slots
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        st = self._st
+        with st.cond:
+            while not st.queue:
+                if st.closed:
+                    raise ChannelClosed("rpc channel closed")
+                wait = 0.2
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        raise TimeoutError("rpc channel read timed out")
+                st.cond.wait(wait)
+            data = st.queue.popleft()
+        return ShmChannel._decode(data)
+
+    def close(self) -> None:
+        with self._st.cond:
+            self._st.closed = True
+            self._st.cond.notify_all()
+
+    def destroy(self) -> None:
+        self.close()
+        registry(self._worker).pop(self._key, None)
+
+
+class RpcChannelWriter:
+    """Pushes encoded values to the consumer worker, windowed by `slots`
+    outstanding acks. Runs on a non-loop thread; RPCs ride the calling
+    worker's event loop."""
+
+    def __init__(self, worker, addr, key: str, slots: int = 8):
+        self._worker = worker
+        self._addr = tuple(addr)
+        self._key = key
+        self.nslots = slots
+        self._inflight: collections.deque = collections.deque()
+        self._client = None
+
+    # -- loop-side helpers ----------------------------------------------
+    async def _ensure_client(self):
+        if self._client is None:
+            from ray_tpu._private.rpc import RpcClient
+
+            self._client = RpcClient(*self._addr, name="dag-chan")
+            await self._client.connect()
+        return self._client
+
+    async def _push(self, payload) -> Dict[str, Any]:
+        client = await self._ensure_client()
+        return await client.call("dag_channel_push", key=self._key,
+                                 payload=pickle.PickleBuffer(payload),
+                                 timeout=600)
+
+    async def _notify(self, method: str) -> None:
+        try:
+            client = await self._ensure_client()
+            await client.call(method, key=self._key, timeout=10)
+        except Exception:
+            pass  # consumer already gone
+
+    # -- thread-side API -------------------------------------------------
+    @staticmethod
+    def encode(value: Any) -> bytes:
+        return ShmChannel.encode(value)
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        self.write_payload(self.encode(value), timeout)
+
+    def write_payload(self, payload: bytes,
+                      timeout: Optional[float] = None) -> None:
+        import asyncio
+
+        while len(self._inflight) >= self.nslots:
+            # Settle BEFORE popping: a settle timeout must keep the
+            # future in the window (retried by the caller), or
+            # backpressure and closed-detection silently vanish.
+            self._settle(self._inflight[0], timeout)
+            self._inflight.popleft()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._push(payload), self._worker.loop)
+        self._inflight.append(fut)
+
+    def _settle(self, fut, timeout: Optional[float]) -> None:
+        from ray_tpu._private.rpc import ConnectionLost
+
+        try:
+            reply = fut.result(timeout=600 if timeout is None else timeout)
+        except ConnectionLost as e:
+            raise ChannelClosed(f"consumer gone: {e!r}") from e
+        except TimeoutError:
+            raise
+        if reply.get("closed"):
+            raise ChannelClosed(self._key)
+
+    def close(self) -> None:
+        import asyncio
+
+        for fut in list(self._inflight):
+            try:
+                self._settle(fut, 10.0)
+            except Exception:
+                pass
+        self._inflight.clear()
+        asyncio.run_coroutine_threadsafe(
+            self._notify("dag_channel_close"), self._worker.loop).result(10)
+
+    def destroy(self) -> None:
+        import asyncio
+
+        asyncio.run_coroutine_threadsafe(
+            self._notify("dag_channel_destroy"),
+            self._worker.loop).result(10)
+        client, self._client = self._client, None
+        if client is not None:
+            asyncio.run_coroutine_threadsafe(
+                client.close(), self._worker.loop).result(10)
+
+
+# ---------------------------------------------------------------------------
+# Descriptor factory: every DAG edge is one of these dicts
+# ---------------------------------------------------------------------------
+
+def open_reader(worker, desc: Dict[str, Any]):
+    if desc["kind"] == "shm":
+        return ShmChannel(desc["path"],
+                          create=bool(desc.get("create")),
+                          slots=int(desc.get("slots", 8)))
+    return RpcChannelReader(worker, desc["key"],
+                            int(desc.get("slots", 8)))
+
+
+def open_writer(worker, desc: Dict[str, Any],
+                timeout: float = 30.0):
+    import os
+
+    if desc["kind"] == "shm":
+        # The READER creates the backing file; wait for it.
+        deadline = time.monotonic() + timeout
+        while not os.path.exists(desc["path"]):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"shm channel {desc['path']} never created")
+            time.sleep(0.005)
+        return ShmChannel(desc["path"])
+    return RpcChannelWriter(worker, desc["addr"], desc["key"],
+                            int(desc.get("slots", 8)))

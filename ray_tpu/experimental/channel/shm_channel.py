@@ -52,17 +52,24 @@ class ShmChannel:
                  create: bool = False, slots: int = 8):
         self.path = path
         if create:
+            # Init at a temp name, rename when the header is valid: a
+            # peer that polls for `path` must never map a zero-length or
+            # header-less file (the creating and opening processes race).
             size = _HDR.size + slots * (_LEN.size + capacity)
-            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            tmp = f"{path}.init{os.getpid()}"
+            fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
             try:
                 os.ftruncate(fd, size)
             finally:
                 os.close(fd)
-        file_size = os.path.getsize(path)
-        self._f = open(path, "r+b")
-        self._mm = mmap.mmap(self._f.fileno(), file_size)
-        if create:
+            self._f = open(tmp, "r+b")
+            self._mm = mmap.mmap(self._f.fileno(), size)
             _HDR.pack_into(self._mm, 0, 0, 0, 0, slots, capacity)
+            os.rename(tmp, path)
+        else:
+            file_size = os.path.getsize(path)
+            self._f = open(path, "r+b")
+            self._mm = mmap.mmap(self._f.fileno(), file_size)
         _, _, _, self.nslots, self.capacity = _HDR.unpack_from(self._mm, 0)
 
     # -- header helpers --------------------------------------------------

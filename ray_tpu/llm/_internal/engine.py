@@ -55,6 +55,13 @@ class EngineConfig:
     # Full prompt pages are indexed by content hash and shared across
     # requests (the engine-side cache the prefix-aware router assumes).
     enable_prefix_cache: bool = True
+    # Batched multi-LoRA (reference: ray.llm multiplex/LoRA deployments →
+    # vLLM punica; here gathered-einsum banks in the jitted steps).
+    # lora_rank 0 disables; max_loras counts ADAPTERS (slot 0 = none).
+    lora_rank: int = 0
+    max_loras: int = 4
+    lora_targets: Tuple[str, ...] = ("q_proj", "k_proj", "v_proj",
+                                     "o_proj")
     # Overlap host scheduling with device compute: dispatch decode window
     # N+1 from window N's DEVICE outputs before N's tokens reach the host.
     pipeline_dispatch: bool = True
@@ -70,6 +77,7 @@ class Request:
     max_tokens: int = 64
     temperature: float = 0.0
     stop_token: Optional[int] = None
+    lora_id: str = ""  # adapter name ("" = base model)
     # runtime state
     slot: int = -1
     generated: int = 0
@@ -153,10 +161,82 @@ class LLMEngine:
         self._free_slots = list(range(cfg.max_seqs))
         self.prefix_cache = (PrefixCache(self.allocator)
                              if cfg.enable_prefix_cache else None)
+        # LoRA banks (slot 0 = zero adapter = base model).
+        self.lora_banks: Optional[Dict[str, Any]] = None
+        self._lora_slots: Dict[str, int] = {}
+        self.lora_idx = np.zeros((cfg.max_seqs,), np.int32)
+        if cfg.lora_rank > 0:
+            self.lora_banks = self._init_lora_banks()
         # Pipelined dispatch state: the in-flight window's device arrays
         # (tokens [K,B], final last_tokens [B], final seq_lens [B]) plus
         # the slot set it was dispatched for.
         self._inflight: Optional[Tuple[Any, Any, Any, frozenset]] = None
+
+    # ------------------------------------------------------------------
+    # LoRA multiplexing
+    # ------------------------------------------------------------------
+    def _init_lora_banks(self) -> Dict[str, Any]:
+        cfg, mcfg = self.cfg, self.model.cfg
+        K = cfg.max_loras + 1  # + the zero adapter
+        r = cfg.lora_rank
+        out_dims = {
+            "q_proj": mcfg.num_heads * mcfg.head_dim,
+            "k_proj": mcfg.num_kv_heads * mcfg.head_dim,
+            "v_proj": mcfg.num_kv_heads * mcfg.head_dim,
+            "o_proj": mcfg.hidden_size,
+        }
+        in_dims = {"q_proj": mcfg.hidden_size, "k_proj": mcfg.hidden_size,
+                   "v_proj": mcfg.hidden_size,
+                   "o_proj": mcfg.num_heads * mcfg.head_dim}
+        banks: Dict[str, Any] = {}
+        for i in range(mcfg.num_layers):
+            banks[f"layers_{i}"] = {
+                t: {"a": jnp.zeros((K, r, in_dims[t]), jnp.float32),
+                    "b": jnp.zeros((K, out_dims[t], r), jnp.float32),
+                    # per-SLOT scale: adapters share the bank, so a
+                    # scalar here would let the last load rescale every
+                    # other adapter's delta
+                    "scale": jnp.ones((K,), jnp.float32)}
+                for t in cfg.lora_targets}
+        return banks
+
+    def load_lora(self, name: str, adapter: Dict[str, Any],
+                  scale: float = 1.0) -> int:
+        """Install adapter weights into a bank slot. `adapter` maps
+        "layers_<i>" → {proj: (A [r, Din], B [Dout, r])}. Returns the
+        slot. Re-loading a name overwrites its slot; bank VALUES update
+        without recompiling the jitted steps (they are traced args)."""
+        if self.lora_banks is None:
+            raise ValueError("engine built with lora_rank=0")
+        slot = self._lora_slots.get(name)
+        if slot is None:
+            if len(self._lora_slots) >= self.cfg.max_loras:
+                raise ValueError(
+                    f"all {self.cfg.max_loras} LoRA slots in use")
+            slot = len(self._lora_slots) + 1  # 0 = zero adapter
+            self._lora_slots[name] = slot
+        for layer, projs in adapter.items():
+            bank_layer = self.lora_banks.get(layer)
+            if bank_layer is None:
+                continue
+            for proj, (a, b) in projs.items():
+                if proj not in bank_layer:
+                    continue
+                bank = bank_layer[proj]
+                bank["a"] = bank["a"].at[slot].set(
+                    jnp.asarray(a, jnp.float32))
+                bank["b"] = bank["b"].at[slot].set(
+                    jnp.asarray(b, jnp.float32))
+                bank["scale"] = bank["scale"].at[slot].set(float(scale))
+        return slot
+
+    def lora_slot(self, name: str) -> int:
+        if not name:
+            return 0
+        slot = self._lora_slots.get(name)
+        if slot is None:
+            raise KeyError(f"LoRA adapter {name!r} not loaded")
+        return slot
 
     # ------------------------------------------------------------------
     # Jitted steps
@@ -167,7 +247,7 @@ class LLMEngine:
         transform = self.param_transform
 
         def one(params, caches, last_tokens, page_table, seq_lens, active,
-                temps, rng):
+                temps, rng, lora, lora_idx):
             if transform is not None:
                 params = transform(params)
             # positions of the NEW token = current length (before write).
@@ -176,7 +256,7 @@ class LLMEngine:
                 {"params": params}, last_tokens[:, None],
                 positions=positions, paged_kv=caches,
                 page_table=page_table, write_mask=active[:, None],
-                seq_lens=seq_lens + 1)
+                seq_lens=seq_lens + 1, lora=lora, lora_idx=lora_idx)
             logits = logits[:, 0].astype(jnp.float32)  # [B, V]
             greedy = jnp.argmax(logits, axis=-1)
             keys = jax.random.split(rng, logits.shape[0] + 1)
@@ -187,13 +267,14 @@ class LLMEngine:
             return toks, new_caches, keys[0]
 
         def decode(params, caches, last_tokens, page_table, seq_lens,
-                   active, temps, rng):
+                   active, temps, rng, lora, lora_idx):
             out = jnp.zeros((K, last_tokens.shape[0]), jnp.int32)
 
             def body(j, carry):
                 caches, toks, lens, rng, out = carry
                 toks, caches, rng = one(params, caches, toks, page_table,
-                                        lens, active, temps, rng)
+                                        lens, active, temps, rng, lora,
+                                        lora_idx)
                 return caches, toks, lens + 1, rng, out.at[j].set(toks)
 
             caches, last, lens, rng, out = jax.lax.fori_loop(
@@ -213,7 +294,7 @@ class LLMEngine:
         transform = self.param_transform
 
         def prefill(params, caches, ids, page_table_row, start, true_len,
-                    temps, rng):
+                    temps, rng, lora, lora_idx):
             if transform is not None:
                 params = transform(params)
             # ids [1, bucket] = the SUFFIX of the prompt from absolute
@@ -224,7 +305,8 @@ class LLMEngine:
             logits, new_caches = model.apply(
                 {"params": params}, ids, positions=positions,
                 paged_kv=caches, page_table=page_table_row[None, :],
-                write_mask=mask, seq_lens=jnp.full((1,), start + true_len))
+                write_mask=mask, seq_lens=jnp.full((1,), start + true_len),
+                lora=lora, lora_idx=lora_idx)
             last = logits[0, true_len - 1].astype(jnp.float32)
             greedy = jnp.argmax(last)
             k1, k0 = jax.random.split(rng)
@@ -256,6 +338,14 @@ class LLMEngine:
             raise ValueError(
                 f"request needs up to {need} cache slots; max context is "
                 f"{self.cache_cfg.max_context}")
+        if req.lora_id:
+            if self.lora_banks is None:
+                raise KeyError(
+                    f"LoRA adapter {req.lora_id!r} requested but the "
+                    "engine was built with lora_rank=0")
+            self.lora_slot(req.lora_id)  # validate HERE, before any
+            # admission-time state mutation — a typo'd adapter must fail
+            # this one request, not poison the running batch
         self.waiting.append(req)
 
     def has_work(self) -> bool:
@@ -329,7 +419,8 @@ class LLMEngine:
         toks, last, lens, self.caches, self._rng = self._decode_fn(
             self.params, self.caches, self._dev(self.last_tokens),
             self._dev(self.page_table), self._dev(self.seq_lens),
-            self._dev(active), self._dev(self.temps), self._rng)
+            self._dev(active), self._dev(self.temps), self._rng,
+            self.lora_banks, self._dev(self.lora_idx))
         return (toks, last, lens, frozenset(self.running))
 
     def _dispatch_window_from_device(self, window):
@@ -340,7 +431,8 @@ class LLMEngine:
         toks, last, lens, self.caches, self._rng = self._decode_fn(
             self.params, self.caches, last,
             self._dev(self.page_table), lens,
-            self._dev(active), self._dev(self.temps), self._rng)
+            self._dev(active), self._dev(self.temps), self._rng,
+            self.lora_banks, self._dev(self.lora_idx))
         return (toks, last, lens, frozenset(self.running))
 
     def _process_window(self, window,
@@ -434,11 +526,15 @@ class LLMEngine:
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :S] = suffix
             self.temps[slot] = req.temperature
+            self.lora_idx[slot] = self.lora_slot(req.lora_id) \
+                if self.lora_banks is not None else 0
             dev_tok, self.caches, self._rng = self._prefill_fn(bucket)(
                 self.params, self.caches, self._dev(ids),
                 self._dev(row), self._dev(np.int32(cached_len)),
                 self._dev(np.int32(S)),
-                self._dev(np.float32(req.temperature)), self._rng)
+                self._dev(np.float32(req.temperature)), self._rng,
+                self.lora_banks,
+                self._dev(np.full((1,), self.lora_idx[slot], np.int32)))
             if self.prefix_cache is not None and digests:
                 # Index this prompt's full pages (now being materialized
                 # in program order) for future requests; no-op for runs
@@ -485,3 +581,4 @@ class LLMEngine:
         self.allocator.release(slot)
         self._free_slots.append(slot)
         self.seq_lens[slot] = 0
+        self.lora_idx[slot] = 0

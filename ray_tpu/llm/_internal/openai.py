@@ -114,11 +114,19 @@ class OpenAIServer:
         return apply_chat_template(self.tokenizer, messages)
 
     def _gen_kwargs(self, body: Dict[str, Any]) -> Dict[str, Any]:
-        return {
+        out = {
             "max_tokens": int(body.get("max_tokens") or 64),
             "temperature": float(body.get("temperature") or 0.0),
             "stop_token": self.tokenizer.eot_id,
         }
+        # "model": "<base>:<adapter>" (or a bare adapter name) selects a
+        # loaded LoRA — the reference's multiplexed model-id convention.
+        model = str(body.get("model") or "")
+        if model and model != self.model_id:
+            prefix = f"{self.model_id}:"
+            out["lora_id"] = (model[len(prefix):]
+                              if model.startswith(prefix) else model)
+        return out
 
     # -- unary -----------------------------------------------------------
     def _completions(self, body: Dict[str, Any]) -> Dict[str, Any]:

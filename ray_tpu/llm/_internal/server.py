@@ -106,8 +106,11 @@ class LLMServer:
     # ------------------------------------------------------------------
     def generate(self, prompt_ids: List[int], max_tokens: int = 64,
                  temperature: float = 0.0,
-                 stop_token: Optional[int] = None) -> Iterator[Dict[str, Any]]:
-        """Streaming generation — one dict per token."""
+                 stop_token: Optional[int] = None,
+                 lora_id: str = "") -> Iterator[Dict[str, Any]]:
+        """Streaming generation — one dict per token. lora_id selects a
+        loaded adapter (reference: the model-id multiplex surface of
+        ray.llm's LoRA deployments)."""
         rid = uuid.uuid4().hex[:12]
         q: "queue.Queue" = queue.Queue()
         with self._lock:
@@ -116,7 +119,8 @@ class LLMServer:
         self._pending.put(Request(rid, list(prompt_ids),
                                   max_tokens=max_tokens,
                                   temperature=temperature,
-                                  stop_token=stop_token))
+                                  stop_token=stop_token,
+                                  lora_id=lora_id))
         first = True
         try:
             while True:
@@ -137,15 +141,22 @@ class LLMServer:
 
     def generate_all(self, prompt_ids: List[int], max_tokens: int = 64,
                      temperature: float = 0.0,
-                     stop_token: Optional[int] = None) -> Dict[str, Any]:
+                     stop_token: Optional[int] = None,
+                     lora_id: str = "") -> Dict[str, Any]:
         """Unary variant: returns all tokens at once."""
         toks = []
         ttft = None
         for item in self.generate(prompt_ids, max_tokens, temperature,
-                                  stop_token):
+                                  stop_token, lora_id):
             toks.append(item["token"])
             ttft = ttft if ttft is not None else item.get("ttft_s")
         return {"tokens": toks, "ttft_s": ttft}
+
+    def load_lora(self, name: str, adapter: Dict[str, Any],
+                  scale: float = 1.0) -> int:
+        """Install a LoRA adapter into the engine's banks (reference:
+        LoRA multiplex deployments' model loading)."""
+        return self.engine.load_lora(name, adapter, scale)
 
     def stats(self) -> Dict[str, Any]:
         return {

@@ -118,13 +118,30 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def lora_delta(x, bank, idx):
+    """Per-sequence batched LoRA (the TPU-native multi-adapter form —
+    reference: ray.llm's LoRA multiplex deployments delegate this to
+    vLLM's punica kernels; here it is two gathered einsums the MXU eats
+    directly). bank = {"a": [K, r, Din], "b": [K, Dout, r], "scale"};
+    idx [B] selects each sequence's adapter (slot 0 = zero adapter)."""
+    a_sel = jnp.take(bank["a"], idx, axis=0)  # [B, r, Din]
+    b_sel = jnp.take(bank["b"], idx, axis=0)  # [B, Dout, r]
+    h1 = jnp.einsum("bsd,brd->bsr", x.astype(jnp.float32),
+                    a_sel.astype(jnp.float32))
+    out = jnp.einsum("bsr,bor->bso", h1, b_sel.astype(jnp.float32))
+    scale = bank.get("scale", 1.0)
+    if jnp.ndim(scale) == 1:  # per-slot scales
+        scale = jnp.take(scale, idx)[:, None, None]
+    return out * scale
+
+
 class Attention(nn.Module):
     cfg: LlamaConfig
     mesh: Optional[Mesh] = None
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_index=None,
-                 paged=None):
+                 paged=None, lora=None, lora_idx=None):
         cfg = self.cfg
         b, s, _ = x.shape
         h, hk, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -134,6 +151,28 @@ class Attention(nn.Module):
         q = dense((h, d), "q_proj")(x)
         k = dense((hk, d), "k_proj")(x)
         v = dense((hk, d), "v_proj")(x)
+        if lora is not None:
+            if "q_proj" in lora:
+                q = q + lora_delta(x, lora["q_proj"], lora_idx).reshape(
+                    b, s, h, d).astype(q.dtype)
+            if "k_proj" in lora:
+                k = k + lora_delta(x, lora["k_proj"], lora_idx).reshape(
+                    b, s, hk, d).astype(k.dtype)
+            if "v_proj" in lora:
+                v = v + lora_delta(x, lora["v_proj"], lora_idx).reshape(
+                    b, s, hk, d).astype(v.dtype)
+
+        def o_proj(out4d):
+            y = nn.DenseGeneral(
+                cfg.hidden_size, axis=(-2, -1), use_bias=False,
+                dtype=cfg.dtype, param_dtype=jnp.float32, name="o_proj")(
+                    out4d)
+            if lora is not None and "o_proj" in lora:
+                flat = out4d.reshape(b, s, h * d)
+                y = y + lora_delta(flat, lora["o_proj"],
+                                   lora_idx).astype(y.dtype)
+            return y
+
         q = apply_rope(q, positions, cfg.rope_theta)
         k = apply_rope(k, positions, cfg.rope_theta)
 
@@ -150,10 +189,7 @@ class Attention(nn.Module):
                                   paged["write_mask"])
             out = paged_attention(q, k_pages, v_pages, paged["page_table"],
                                   pos2d, paged["seq_lens"])
-            out = nn.DenseGeneral(
-                cfg.hidden_size, axis=(-2, -1), use_bias=False,
-                dtype=cfg.dtype, param_dtype=jnp.float32, name="o_proj")(out)
-            return out, (k_pages, v_pages)
+            return o_proj(out), (k_pages, v_pages)
 
         if kv_cache is not None:
             # Decode: append to cache, attend over the prefix.
@@ -166,11 +202,7 @@ class Attention(nn.Module):
             q_pos = cache_index + jnp.arange(s)
             logits_mask = k_ids[None, :] <= q_pos[:, None]
             out = _masked_attention(q, ck, cv, logits_mask, cfg)
-            new_cache = (ck, cv)
-            out = nn.DenseGeneral(
-                cfg.hidden_size, axis=(-2, -1), use_bias=False,
-                dtype=cfg.dtype, param_dtype=jnp.float32, name="o_proj")(out)
-            return out, new_cache
+            return o_proj(out), (ck, cv)
 
         if cfg.attention_impl == "ring" and self.mesh is not None:
             from ray_tpu.parallel.ring import ring_attention
@@ -180,10 +212,7 @@ class Attention(nn.Module):
             out = flash_attention(q, k, v, causal=True)
         else:
             out = attention_reference(q, k, v, causal=True)
-        out = nn.DenseGeneral(
-            cfg.hidden_size, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
-            param_dtype=jnp.float32, name="o_proj")(out)
-        return out, None
+        return o_proj(out), None
 
 
 def _masked_attention(q, k, v, mask, cfg: LlamaConfig):
@@ -220,11 +249,11 @@ class DecoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, cache_index=None,
-                 paged=None):
+                 paged=None, lora=None, lora_idx=None):
         cfg = self.cfg
         attn_out, new_cache = Attention(cfg, self.mesh, name="self_attn")(
             RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="input_layernorm")(x),
-            positions, kv_cache, cache_index, paged)
+            positions, kv_cache, cache_index, paged, lora, lora_idx)
         x = x + attn_out
         if cfg.num_experts > 0:
             from ray_tpu.models.moe import MoEMlp
@@ -248,7 +277,12 @@ class LlamaModel(nn.Module):
     @nn.compact
     def __call__(self, input_ids, positions=None, kv_caches=None,
                  cache_index=None, paged_kv=None, page_table=None,
-                 write_mask=None, seq_lens=None):
+                 write_mask=None, seq_lens=None, lora=None,
+                 lora_idx=None):
+        """lora: {"layers_<i>": {proj: {"a": [K,r,Din], "b": [K,Dout,r],
+        "scale": s}}} adapter BANKS (runtime jit args, not flax params —
+        adapter loads update values without recompiling); lora_idx [B]
+        picks each sequence's adapter, slot 0 = none."""
         cfg = self.cfg
         if positions is None:
             start = cache_index if (kv_caches is not None
@@ -266,8 +300,10 @@ class LlamaModel(nn.Module):
             if paged_kv is not None:
                 paged = {"kv_pages": paged_kv[i], "page_table": page_table,
                          "write_mask": write_mask, "seq_lens": seq_lens}
+            layer_lora = (lora or {}).get(f"layers_{i}")
             x, new_cache = layer_cls(cfg, self.mesh, name=f"layers_{i}")(
-                x, positions, cache, cache_index, paged)
+                x, positions, cache, cache_index, paged, layer_lora,
+                lora_idx)
             new_caches.append(new_cache)
         x = RMSNorm(cfg.rms_norm_eps, cfg.dtype, name="norm")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,

@@ -209,6 +209,10 @@ def _state_command(args) -> None:
             from ray_tpu.util.metrics import query_metrics
 
             out = query_metrics()
+        elif args.command == "stack":
+            out = state.stack_dump()
+        elif args.command == "proc-stats":
+            out = state.node_proc_stats()
         else:
             out = state.list_placement_groups()
         json.dump(out, sys.stdout, indent=2, default=_jsonable)
@@ -259,7 +263,7 @@ def main() -> None:
 
     for name in ("status", "nodes", "actors", "workers", "jobs",
                  "placement-groups", "tasks", "timeline", "memory",
-                 "metrics"):
+                 "metrics", "stack", "proc-stats"):
         p = sub.add_parser(name)
         p.add_argument("--address")
         p.set_defaults(fn=_state_command)

@@ -31,7 +31,7 @@ class ServeController:
         self._version = 0
         self._running = False
         self._http_port: Optional[int] = None
-        self._downscale_streak: Dict[str, int] = {}
+        self._autoscale_state: Dict[str, Dict[str, Any]] = {}
         self._lock = threading.RLock()
 
     def start_loops(self) -> None:
@@ -152,12 +152,20 @@ class ServeController:
 
     def _autoscale(self, name: str, cfg: DeploymentConfig,
                    replicas) -> None:
+        """Smoothed, delay-windowed replica autoscaling (reference:
+        serve/autoscaling_policy.py — EMA over the load metric plus
+        upscale_delay_s/downscale_delay_s so bursty traffic doesn't thrash
+        replica counts; the decision must SUSTAIN for the window before it
+        applies)."""
         ac = cfg.autoscaling_config
         if not ac or not replicas:
             return
         target = max(0.1, float(ac.get("target_ongoing_requests", 1.0)))
         lo = int(ac.get("min_replicas", 1))
         hi = int(ac.get("max_replicas", max(lo, cfg.num_replicas)))
+        up_delay = float(ac.get("upscale_delay_s", 3.0))
+        down_delay = float(ac.get("downscale_delay_s", 10.0))
+        alpha = min(1.0, max(0.05, float(ac.get("smoothing_factor", 0.6))))
         total = 0
         for info in list(replicas):
             try:
@@ -165,22 +173,34 @@ class ServeController:
                     info.actor.num_ongoing_requests.remote(), timeout=10)
             except Exception:
                 pass
-        desired = max(lo, min(hi, -(-int(total) // int(target)) or lo))
+        st = self._autoscale_state.setdefault(
+            name, {"ema": None, "up_since": None, "down_since": None})
+        import math
+
+        st["ema"] = (float(total) if st["ema"] is None
+                     else alpha * total + (1 - alpha) * st["ema"])
+        desired = max(lo, min(hi, math.ceil(st["ema"] / target) or lo))
+        now = time.monotonic()
         if desired > cfg.num_replicas:
-            logger.info("autoscaling %s: %d ongoing -> %d replicas", name,
-                        total, desired)
-            cfg.num_replicas = desired
-            self._downscale_streak.pop(name, None)
-        elif desired < cfg.num_replicas:
-            streak = self._downscale_streak.get(name, 0) + 1
-            self._downscale_streak[name] = streak
-            if streak >= 5:  # ~5 reconcile periods of low load
-                logger.info("autoscaling %s: idle -> %d replicas", name,
-                            desired)
+            st["down_since"] = None
+            if st["up_since"] is None:
+                st["up_since"] = now
+            if now - st["up_since"] >= up_delay:
+                logger.info("autoscaling %s: ema %.1f ongoing -> %d "
+                            "replicas", name, st["ema"], desired)
                 cfg.num_replicas = desired
-                self._downscale_streak[name] = 0
+                st["up_since"] = None
+        elif desired < cfg.num_replicas:
+            st["up_since"] = None
+            if st["down_since"] is None:
+                st["down_since"] = now
+            if now - st["down_since"] >= down_delay:
+                logger.info("autoscaling %s: idle (ema %.1f) -> %d "
+                            "replicas", name, st["ema"], desired)
+                cfg.num_replicas = desired
+                st["down_since"] = None
         else:
-            self._downscale_streak.pop(name, None)
+            st["up_since"] = st["down_since"] = None
 
     def _reconcile_once(self, health_check: bool = False) -> None:
         from ray_tpu.serve._replica import ReplicaActor

@@ -72,6 +72,43 @@ def list_workers() -> List[Dict[str, Any]]:
     return w.loop_thread.run(_collect())
 
 
+def _collect_per_node(method: str) -> Dict[str, Any]:
+    import asyncio
+
+    w = worker_mod.global_worker()
+
+    async def _one(n):
+        try:
+            client = await w.nodelet_client_for_node(n["node_id"])
+            return n["node_id"].hex()[:12], await asyncio.wait_for(
+                client.call(method), 30)
+        except Exception as e:  # noqa: BLE001
+            return n["node_id"].hex()[:12], {"error": repr(e)}
+
+    async def _collect():
+        nodes = await w.gcs_client.call("list_nodes")
+        # Concurrent fan-out: one slow/unreachable node bounds the call at
+        # ITS timeout, not the sum over nodes.
+        pairs = await asyncio.gather(
+            *[_one(n) for n in nodes if n["alive"]])
+        return dict(pairs)
+
+    return w.loop_thread.run(_collect())
+
+
+def stack_dump() -> Dict[str, Any]:
+    """All-thread python stacks of every worker on every node — the
+    `ray stack` surface (reference: scripts.py `ray stack` + the
+    dashboard agent's py-spy endpoints)."""
+    return _collect_per_node("node_stacks")
+
+
+def node_proc_stats() -> Dict[str, Any]:
+    """Per-process cpu/rss/threads for every node's workers (reference:
+    the reporter agent's psutil sampling)."""
+    return _collect_per_node("node_proc_stats")
+
+
 def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
     """Recently finished task executions (reference: `ray list tasks`,
     backed by GcsTaskManager events)."""

@@ -120,19 +120,23 @@ def test_dag_channel_actor_death_raises(ray_start_regular):
     dag.teardown()
 
 
-def test_dag_nonlinear_falls_back_to_actor_push(ray_start_regular):
-    from ray_tpu.dag import MultiOutputNode
-
+def test_dag_unsupported_shape_falls_back_to_actor_push(ray_start_regular):
+    """Graphs the channel compiler doesn't take (constant args) replay
+    through actor pushes. (MultiOutput/branching graphs DO take channels
+    now — test_dag_graph_channels.py covers those.)"""
     @ray_tpu.remote
     class Stage:
         def work(self, x):
             return x * 2
 
+        def add_const(self, x, k):
+            return x + k
+
     s1, s2 = Stage.remote(), Stage.remote()
+    ray_tpu.get([s1.work.remote(0), s2.work.remote(0)])
     with InputNode() as inp:
-        fan = MultiOutputNode([s1.work.bind(inp), s2.work.bind(inp)])
-    dag = fan.experimental_compile()
+        node = s2.add_const.bind(s1.work.bind(inp), 100)  # constant arg
+    dag = node.experimental_compile()
     assert not dag._channel_mode
-    r1, r2 = dag.execute(3)
-    assert ray_tpu.get(r1) == 6 and ray_tpu.get(r2) == 6
+    assert ray_tpu.get(dag.execute(3)) == 106
     dag.teardown()

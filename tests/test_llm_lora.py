@@ -1,0 +1,138 @@
+"""Batched multi-LoRA serving (reference: ray.llm LoRA multiplex
+deployments, llm/_internal/serve/deployments/llm/multiplex/ — vLLM punica
+there; gathered-einsum adapter banks inside the jitted steps here)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.llm._internal.engine import (  # noqa: E402
+    EngineConfig,
+    LLMEngine,
+    Request,
+)
+from ray_tpu.models.llama import LlamaConfig, LlamaModel, lora_delta  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def _rand_adapter(cfg, rng, r=4, scale=0.5):
+    """Adapter for every layer's q/v projections."""
+    adapter = {}
+    for i in range(cfg.num_layers):
+        key_q, key_v, rng = *jax.random.split(rng, 2), rng
+        h = cfg.hidden_size
+        adapter[f"layers_{i}"] = {
+            "q_proj": (
+                0.2 * jax.random.normal(key_q, (r, h)),
+                0.2 * jax.random.normal(jax.random.fold_in(key_q, 1),
+                                        (cfg.num_heads * cfg.head_dim, r)),
+            ),
+            "v_proj": (
+                0.2 * jax.random.normal(key_v, (r, h)),
+                0.2 * jax.random.normal(
+                    jax.random.fold_in(key_v, 1),
+                    (cfg.num_kv_heads * cfg.head_dim, r)),
+            ),
+        }
+    return adapter, scale
+
+
+def test_lora_delta_matches_manual():
+    K, r, din, dout, b, s = 3, 4, 16, 8, 2, 5
+    rng = np.random.default_rng(0)
+    bank = {"a": jnp.asarray(rng.normal(size=(K, r, din)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(K, dout, r)), jnp.float32),
+            "scale": 0.7}
+    x = jnp.asarray(rng.normal(size=(b, s, din)), jnp.float32)
+    idx = jnp.asarray([2, 0], jnp.int32)
+    out = lora_delta(x, bank, idx)
+    for bi, k in enumerate([2, 0]):
+        manual = (np.asarray(x[bi]) @ np.asarray(bank["a"][k]).T
+                  @ np.asarray(bank["b"][k]).T) * 0.7
+        np.testing.assert_allclose(np.asarray(out[bi]), manual, rtol=2e-4)
+
+
+def test_lora_matches_merged_weights(tiny):
+    """The in-jit banked LoRA path must equal running the base model with
+    adapter-merged weights (W' = W + scale * B @ A) — the ground truth."""
+    cfg, model, params = tiny
+    adapter, scale = _rand_adapter(cfg, jax.random.PRNGKey(7))
+    ids = jnp.asarray([[5, 17, 42, 7, 9]], jnp.int32)
+
+    # banked path
+    eng = LLMEngine(model, params, EngineConfig(
+        max_seqs=2, page_size=4, max_pages_per_seq=16, lora_rank=4,
+        enable_prefix_cache=False))
+    eng.load_lora("ad1", adapter, scale=scale)
+    bank_logits = model.apply(
+        {"params": params}, ids, lora=eng.lora_banks,
+        lora_idx=jnp.asarray([1], jnp.int32))
+
+    # merged-weights oracle
+    import copy
+
+    merged = jax.tree.map(lambda x: x, params)
+    for lname, projs in adapter.items():
+        for proj, (a, b) in projs.items():
+            kernel = merged[lname]["self_attn"][proj]["kernel"]
+            delta = scale * (np.asarray(b) @ np.asarray(a))  # [out, in]
+            merged[lname]["self_attn"][proj]["kernel"] = (
+                kernel + jnp.asarray(delta.T).reshape(kernel.shape))
+    merged_logits = model.apply({"params": merged}, ids)
+    np.testing.assert_allclose(np.asarray(bank_logits),
+                               np.asarray(merged_logits),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_mixed_batch_lora_and_base(tiny):
+    """Concurrent requests with different adapters (incl. none) must match
+    each request run alone — per-sequence adapter isolation."""
+    cfg, model, params = tiny
+    adapter, scale = _rand_adapter(cfg, jax.random.PRNGKey(3))
+
+    def run(requests):
+        eng = LLMEngine(model, params, EngineConfig(
+            max_seqs=4, page_size=4, max_pages_per_seq=16, lora_rank=4,
+            decode_steps=2, enable_prefix_cache=False))
+        eng.load_lora("ad1", adapter, scale=scale)
+        for r in requests:
+            eng.add_request(r)
+        got = {}
+        steps = 0
+        while eng.has_work() and steps < 300:
+            for so in eng.step():
+                got.setdefault(so.request_id, []).append(so.token)
+            steps += 1
+        return got
+
+    p1, p2 = [5, 17, 42, 7], [9, 3, 11, 2, 6]
+    solo_base = run([Request("b", p1, max_tokens=6)])["b"]
+    solo_lora = run([Request("l", p2, max_tokens=6, lora_id="ad1")])["l"]
+    mixed = run([Request("b", p1, max_tokens=6),
+                 Request("l", p2, max_tokens=6, lora_id="ad1")])
+    assert mixed["b"] == solo_base
+    assert mixed["l"] == solo_lora
+    # and the adapter actually changes the output
+    base_p2 = run([Request("x", p2, max_tokens=6)])["x"]
+    assert base_p2 != solo_lora
+
+
+def test_unknown_adapter_raises(tiny):
+    cfg, model, params = tiny
+    eng = LLMEngine(model, params, EngineConfig(
+        max_seqs=2, page_size=4, max_pages_per_seq=16, lora_rank=4))
+    # Validated at ENQUEUE: a typo'd adapter fails this request alone
+    # instead of erroring the whole running batch mid-admission.
+    with pytest.raises(KeyError, match="nope"):
+        eng.add_request(Request("r", [1, 2, 3], max_tokens=4,
+                                lora_id="nope"))
