@@ -285,38 +285,44 @@ class LLMEngine:
 
         return jax.jit(decode, donate_argnums=(1,))
 
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_fns.get(bucket)
+    def _prefill_fn(self, bucket: int, nb: int = 1):
+        """Batched prefill: `nb` sequences in ONE pass over the weights —
+        a wave of admissions streams the (dequantized) parameters once
+        instead of once per request, the dominant term in TTFT for
+        HBM-bound models."""
+        fn = self._prefill_fns.get((bucket, nb))
         if fn is not None:
             return fn
         model = self.model
 
         transform = self.param_transform
 
-        def prefill(params, caches, ids, page_table_row, start, true_len,
+        def prefill(params, caches, ids, rows, starts, true_lens,
                     temps, rng, lora, lora_idx):
             if transform is not None:
                 params = transform(params)
-            # ids [1, bucket] = the SUFFIX of the prompt from absolute
-            # position `start` (start > 0 when a cached prefix run was
-            # shared into the page table); causal within the sequence.
-            positions = start + jnp.arange(bucket)[None, :]
-            mask = jnp.arange(bucket)[None, :] < true_len
+            # ids [nb, bucket] = each prompt's SUFFIX from absolute
+            # position starts[i] (>0 when a cached prefix run was shared
+            # into its page-table row); causal within each sequence.
+            positions = starts[:, None] + jnp.arange(bucket)[None, :]
+            mask = jnp.arange(bucket)[None, :] < true_lens[:, None]
             logits, new_caches = model.apply(
                 {"params": params}, ids, positions=positions,
-                paged_kv=caches, page_table=page_table_row[None, :],
-                write_mask=mask, seq_lens=jnp.full((1,), start + true_len),
+                paged_kv=caches, page_table=rows,
+                write_mask=mask, seq_lens=starts + true_lens,
                 lora=lora, lora_idx=lora_idx)
-            last = logits[0, true_len - 1].astype(jnp.float32)
-            greedy = jnp.argmax(last)
-            k1, k0 = jax.random.split(rng)
-            sampled = jax.random.categorical(
-                k1, last / jnp.maximum(temps, 1e-3))
-            tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-            return tok, new_caches, k0
+            last = logits[jnp.arange(nb), true_lens - 1].astype(
+                jnp.float32)  # [nb, V]
+            greedy = jnp.argmax(last, axis=-1)
+            keys = jax.random.split(rng, nb + 1)
+            sampled = jax.vmap(
+                lambda k, l, t: jax.random.categorical(
+                    k, l / jnp.maximum(t, 1e-3)))(keys[1:], last, temps)
+            toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            return toks, new_caches, keys[0]
 
         fn = jax.jit(prefill, donate_argnums=(1,))
-        self._prefill_fns[bucket] = fn
+        self._prefill_fns[(bucket, nb)] = fn
         return fn
 
     def _dev(self, x):
@@ -468,13 +474,14 @@ class LLMEngine:
         return finished_any
 
     def _admit(self, out: List[StepOutput]) -> bool:
-        """Admit as many waiting requests as fit. Prefills dispatch
-        back-to-back WITHOUT a host sync in between (the first token stays
-        on device until every admitted prefill is in flight), so TTFT for
-        a wave of admissions is one pipelined pass over the weights, not
-        N serial host round trips."""
+        """Admit as many waiting requests as fit. The wave's prefills run
+        BATCHED per bucket — one pass over the (dequantized) weights for
+        the whole admission wave, not one per request — and the first
+        tokens stay on device until every batch is in flight, so TTFT for
+        N admissions is ~one weight stream + one host sync."""
         admitted = False
-        pending: List[Tuple[int, Request, Any]] = []  # slot, req, dev tok
+        # bucket -> list of (slot, req, suffix_ids, cached_len, S)
+        waves: Dict[int, List[Tuple[int, Request, Any, int, int]]] = {}
         ps = self.cache_cfg.page_size
         while self.waiting and self._free_slots:
             req: Request = self.waiting[0]
@@ -523,30 +530,46 @@ class LLMEngine:
             S = len(suffix)
             bucket = next((b for b in self.cfg.prefill_buckets if b >= S),
                           self.cache_cfg.max_context)
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :S] = suffix
             self.temps[slot] = req.temperature
             self.lora_idx[slot] = self.lora_slot(req.lora_id) \
                 if self.lora_banks is not None else 0
-            dev_tok, self.caches, self._rng = self._prefill_fn(bucket)(
-                self.params, self.caches, self._dev(ids),
-                self._dev(row), self._dev(np.int32(cached_len)),
-                self._dev(np.int32(S)),
-                self._dev(np.float32(req.temperature)), self._rng,
-                self.lora_banks,
-                self._dev(np.full((1,), self.lora_idx[slot], np.int32)))
             if self.prefix_cache is not None and digests:
-                # Index this prompt's full pages (now being materialized
-                # in program order) for future requests; no-op for runs
-                # already cached.
+                # Index this prompt's full pages (materialized in program
+                # order by the wave dispatch below) for future requests;
+                # no-op for runs already cached.
                 n_full = len(digests)
                 self.prefix_cache.insert(
                     digests, self.allocator.slot_pages[slot][:n_full])
             self.seq_lens[slot] = T
             req.generated = 1
-            pending.append((slot, req, dev_tok))
-        for slot, req, dev_tok in pending:
-            tok = int(dev_tok)  # sync: by now all prefills are in flight
+            waves.setdefault(bucket, []).append(
+                (slot, req, suffix, cached_len, S))
+        pending: List[Tuple[int, Request, Any, int]] = []
+        for bucket, wave in waves.items():
+            nb = len(wave)
+            ids = np.zeros((nb, bucket), np.int32)
+            rows = np.zeros((nb, self.cfg.max_pages_per_seq), np.int32)
+            starts = np.zeros((nb,), np.int32)
+            lens = np.zeros((nb,), np.int32)
+            temps = np.zeros((nb,), np.float32)
+            lidx = np.zeros((nb,), np.int32)
+            for i, (slot, req, suffix, cached_len, S) in enumerate(wave):
+                ids[i, :S] = suffix
+                rows[i] = self.page_table[slot]
+                starts[i] = cached_len
+                lens[i] = S
+                temps[i] = req.temperature
+                lidx[i] = self.lora_idx[slot]
+            dev_toks, self.caches, self._rng = self._prefill_fn(
+                bucket, nb)(
+                self.params, self.caches, self._dev(ids),
+                self._dev(rows), self._dev(starts), self._dev(lens),
+                self._dev(temps), self._rng, self.lora_banks,
+                self._dev(lidx))
+            for i, (slot, req, _, _, _) in enumerate(wave):
+                pending.append((slot, req, dev_toks, i))
+        for slot, req, dev_toks, i in pending:
+            tok = int(np.asarray(dev_toks)[i])  # sync: all waves in flight
             self.last_tokens[slot] = tok
             finished = (req.generated >= req.max_tokens
                         or (req.stop_token is not None
