@@ -857,20 +857,28 @@ def on_owner_ref_zero(worker, object_id) -> None:
         return
 
     async def _free():
+        import asyncio
+
         from ray_tpu._private.rpc import RpcClient
 
-        client = None
-        try:
-            client = RpcClient(*src, name="device-free")
-            await client.notify("device_object_free", object_id=binary)
-        except Exception:
-            pass
-        finally:
-            if client is not None:
-                try:
-                    await client.close()
-                except Exception:
-                    pass
+        # Retried: this fires exactly once per ref, so a dropped notify
+        # (source briefly unreachable under load) would otherwise leak the
+        # HBM entry for the source's lifetime.
+        for attempt in range(3):
+            client = None
+            try:
+                client = RpcClient(*src, name="device-free")
+                await client.call("device_object_free",
+                                  object_id=binary, timeout=10)
+                return
+            except Exception:
+                await asyncio.sleep(1.0 * (attempt + 1))
+            finally:
+                if client is not None:
+                    try:
+                        await client.close()
+                    except Exception:
+                        pass
 
     try:
         worker.loop.call_soon_threadsafe(

@@ -84,11 +84,11 @@ def test_actor_tensor_transport_device(ray_start_regular):
     # Owner-driven free: dropping the driver's ref tells the producer to
     # drop its HBM copy.
     del ref, out
-    deadline = time.time() + 10
+    deadline = time.time() + 30  # free is async + retried; loaded hosts
     while time.time() < deadline:
         if ray_tpu.get(p.store_size.remote()) == 0:
             break
-        time.sleep(0.1)
+        time.sleep(0.2)
     assert ray_tpu.get(p.store_size.remote()) == 0
 
 
