@@ -106,6 +106,14 @@ def broadcast_bench(ray_tpu, cluster, *, n_nodes: int = 4,
 
 def run_scale_suite(ray_tpu, cluster=None,
                     progress=None) -> Dict[str, Any]:
+    # The arena's background prefault (~11 µs/page here) must not bleed
+    # CPU into the measured windows on a 1-core host.
+    try:
+        from ray_tpu._private import worker as _wm
+
+        _wm.global_worker().shm.wait_prefault(120)
+    except Exception:
+        pass
     out: Dict[str, Any] = {}
     for name, fn in (("many_actors", many_actors_bench),
                      ("many_tasks", many_tasks_bench),

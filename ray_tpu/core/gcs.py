@@ -366,6 +366,7 @@ class GcsServer:
         self, node_id: bytes, resources_available: Dict[str, float],
         load: Optional[Dict[str, Any]] = None,
         demand: Optional[List[Dict[str, float]]] = None,
+        version: int = 0,
     ) -> Dict[str, Any]:
         nid = NodeID(node_id)
         info = self.nodes.get(nid)
@@ -375,8 +376,38 @@ class GcsServer:
             # rejoin scheduling — its actors were already failed over.
             return {"ok": False, "reregister": True}
         info.last_heartbeat = time.monotonic()
+        self._apply_resource_view(info, version, resources_available,
+                                  demand or [])
+        return {"ok": True}
+
+    @staticmethod
+    def _apply_resource_view(info, version: int,
+                             resources_available: Dict[str, float],
+                             demand: List[Dict[str, float]]) -> None:
+        """Versioned apply (reference: ray_syncer's versioned snapshots,
+        ray_syncer.h:40): an out-of-order sync or a heartbeat racing a
+        fresher push must never roll the view back."""
+        current = getattr(info, "resource_version", 0)
+        if version < current:
+            return
+        info.resource_version = version
         info.resources_available = resources_available
-        info.demand = demand or []
+        info.demand = demand
+
+    async def rpc_sync_resources(
+        self, node_id: bytes, version: int,
+        resources_available: Dict[str, float],
+        demand: Optional[List[Dict[str, float]]] = None,
+    ) -> Dict[str, Any]:
+        """Event-driven resource-view push (the ray_syncer analog): sent
+        by nodelets within ~50 ms of an availability/demand change, so
+        scheduling and autoscaling views are bounded by the debounce, not
+        the heartbeat period."""
+        info = self.nodes.get(NodeID(node_id))
+        if info is None or not info.alive:
+            return {"ok": False, "reregister": True}
+        self._apply_resource_view(info, version, resources_available,
+                                  demand or [])
         return {"ok": True}
 
     async def rpc_list_nodes(self) -> List[Dict[str, Any]]:

@@ -212,6 +212,12 @@ class Nodelet:
         # Preforked worker template (started on first plain-CPU spawn).
         self._zygote_proc: Optional[subprocess.Popen] = None
         self._zygote_sock: str = ""
+        # Versioned resource view (ray_syncer analog): bumped on every
+        # availability/demand change, pushed by _resource_sync_loop.
+        # The Event exists from construction so bumps before the sync
+        # loop's first iteration are not lost to the heartbeat fallback.
+        self._resource_version = 0
+        self._sync_event = asyncio.Event()
 
     # ------------------------------------------------------------------
     async def start(self) -> Tuple[str, int]:
@@ -229,6 +235,8 @@ class Nodelet:
             labels={"node_name": self.node_name},
         )
         self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._background.append(
+            asyncio.ensure_future(self._resource_sync_loop()))
         self._background.append(asyncio.ensure_future(self._reap_loop()))
         self._background.append(
             asyncio.ensure_future(self._memory_monitor_loop()))
@@ -567,6 +575,7 @@ class Nodelet:
                 return {"ok": False, "error": "unknown placement bundle"}
             if req.fits_in(pool):
                 req.subtract_from(pool)
+                self._bump_resources()
                 # Disjoint chip assignment per whole-chip lease; fractional
                 # leases share chip 0 (reference: tpu.py visibility).
                 chips: List[int] = []
@@ -582,6 +591,8 @@ class Nodelet:
                                                          needs_tpu, chips)
                 except Exception as e:
                     req.add_to(pool)
+                    self._bump_resources()  # rollback must sync too, or
+                    # the GCS under-schedules this node for a heartbeat
                     if num_tpus >= 1:
                         self._tpu_chips_free.extend(chips)
                     return {"ok": False, "error": f"worker start failed: {e!r}"}
@@ -651,6 +662,41 @@ class Nodelet:
     def _wake_lease_waiters(self) -> None:
         for event in self._lease_waiters:
             event.set()
+        self._bump_resources()
+
+    # ------------------------------------------------------------------
+    # Resource syncer (reference: common/ray_syncer — versioned resource
+    # views pushed on CHANGE over a bidi stream, not polled; here a
+    # debounced push RPC with a monotonic version, with the heartbeat as
+    # the liveness carrier and periodic full-snapshot fallback)
+    # ------------------------------------------------------------------
+    def _bump_resources(self) -> None:
+        """Mark the resource view dirty: bumps the version and kicks the
+        sync loop so the GCS sees the change within the debounce window
+        (~50 ms), not a heartbeat period later."""
+        self._resource_version += 1
+        self._sync_event.set()
+
+    async def _resource_sync_loop(self) -> None:
+        while not self._shutting_down:
+            try:
+                await self._sync_event.wait()
+                await asyncio.sleep(0.05)  # debounce bursts of changes
+                self._sync_event.clear()
+                version = self._resource_version
+                await self._gcs.call(
+                    "sync_resources",
+                    node_id=self.node_id.binary(),
+                    version=version,
+                    resources_available=dict(self.resources_available),
+                    demand=self._demand_snapshot(),
+                )
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                # Dropped sync: the next change or heartbeat (which also
+                # carries the version) re-converges the view.
+                await asyncio.sleep(0.5)
 
     # ------------------------------------------------------------------
     # Placement group bundles: 2-phase prepare/commit (reference:
@@ -663,6 +709,7 @@ class Nodelet:
         if not req.fits_in(self.resources_available):
             return {"ok": False, "error": "insufficient resources"}
         req.subtract_from(self.resources_available)
+        self._bump_resources()
         self._bundles[(pg_id, bundle_index)] = {
             "resources": dict(req), "available": dict(req), "state": "prepared",
         }
@@ -872,6 +919,7 @@ class Nodelet:
         raylet's ResourceLoad)."""
         key = repr(sorted(resources.items()))
         self._unmet_demand[key] = (dict(resources), time.monotonic())
+        self._bump_resources()
 
     def _demand_snapshot(self) -> List[Dict[str, float]]:
         cutoff = time.monotonic() - 30.0
@@ -889,6 +937,7 @@ class Nodelet:
                     node_id=self.node_id.binary(),
                     resources_available=dict(self.resources_available),
                     demand=self._demand_snapshot(),
+                    version=self._resource_version,
                 )
                 if not reply.get("ok") and reply.get("reregister"):
                     # GCS declared us dead (transient stall past the failure

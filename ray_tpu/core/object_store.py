@@ -116,9 +116,17 @@ class SharedMemoryStore:
             self._handle = self._lib.shm_store_open(path.encode())
         if not self._handle:
             raise OSError(f"failed to {'create' if create else 'open'} shm store {path}")
-        # Background page prefault: first-touch tmpfs page allocation would
-        # otherwise dominate large puts (see shm_store.cc:shm_store_prefault).
-        self._lib.shm_store_prefault(self._handle, 1 if create else 0)
+        # Background page prefault. The creator's MADV_POPULATE_WRITE
+        # allocates the tmpfs pages once; other long-lived processes
+        # (drivers) sweep too so their large puts hit populated PTEs. But
+        # WORKERS skip it: a short-lived worker never amortizes a
+        # full-arena PTE sweep (~0.3 s of one-core work per 2 GiB —
+        # measured 8x slower 50-actor churn windows with per-worker
+        # sweeps) and faults in lazily instead.
+        if create or not os.environ.get("RAY_TPU_WORKER_ID"):
+            self._lib.shm_store_prefault(self._handle, 1 if create else 0)
+        else:
+            self._prefault_skipped = True
         base = self._lib.shm_store_base(self._handle)
         size = self._lib.shm_store_map_size(self._handle)
         self._base_addr = base
@@ -250,9 +258,12 @@ class SharedMemoryStore:
 
     def wait_prefault(self, timeout_s: float = 60.0) -> bool:
         """Block until the background page-population pass completes (used by
-        benchmarks; ordinary operation never needs to wait)."""
+        benchmarks; ordinary operation never needs to wait). Clients skip
+        the sweep entirely (see __init__) — nothing to wait for."""
         import time as _time
 
+        if getattr(self, "_prefault_skipped", False):
+            return True
         deadline = _time.monotonic() + timeout_s
         while _time.monotonic() < deadline:
             if self._lib.shm_store_prefault_done(self._handle):
