@@ -2598,6 +2598,15 @@ class Worker:
             await self._flush_borrow_reports()
 
     async def _flush_borrow_reports(self) -> None:
+        # Serialized: report order is part of the borrow protocol (a
+        # requeued 'add' must never be overtaken by its 'remove'), so a
+        # caller-triggered flush must not interleave with the loop's.
+        lock = self.__dict__.setdefault("_borrow_flush_lock",
+                                        asyncio.Lock())
+        async with lock:
+            await self._flush_borrow_reports_locked()
+
+    async def _flush_borrow_reports_locked(self) -> None:
         reports = self.ref_counter.drain_borrow_reports()
         for owner, ops in reports.items():
             if owner == self.address:

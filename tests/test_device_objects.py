@@ -69,6 +69,13 @@ def test_actor_tensor_transport_device(ray_start_regular):
             assert "jax" in type(payload["w"]).__module__
             return float(payload["w"].sum())
 
+        def flush_borrows(self):
+            from ray_tpu._private import worker as wm
+
+            w = wm.global_worker()
+            w.loop_thread.run(w._flush_borrow_reports())
+            return True
+
     p = Producer.remote()
     c = Consumer.remote()
     ref = p.make.options(tensor_transport="device").remote(64)
@@ -82,13 +89,18 @@ def test_actor_tensor_transport_device(ray_start_regular):
     assert float(out["w"][0]) == 2.0 and out["n"] == 64
 
     # Owner-driven free: dropping the driver's ref tells the producer to
-    # drop its HBM copy.
+    # drop its HBM copy — once the consumer's borrow is released. Drive
+    # the protocol explicitly instead of betting on background report
+    # cadence under a loaded suite: poke the borrower's flush each round.
     del ref, out
-    deadline = time.time() + 30  # free is async + retried; loaded hosts
+    deadline = time.time() + 30
     while time.time() < deadline:
+        # Only the CONSUMER participates in the release protocol here
+        # (the driver owns the ref; owners don't send borrow reports).
+        ray_tpu.get(c.flush_borrows.remote())
         if ray_tpu.get(p.store_size.remote()) == 0:
             break
-        time.sleep(0.2)
+        time.sleep(0.5)
     assert ray_tpu.get(p.store_size.remote()) == 0
 
 
