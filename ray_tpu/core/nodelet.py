@@ -21,6 +21,7 @@ import signal
 import socket as socket_mod
 import subprocess
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -212,6 +213,13 @@ class Nodelet:
         # Preforked worker template (started on first plain-CPU spawn).
         self._zygote_proc: Optional[subprocess.Popen] = None
         self._zygote_sock: str = ""
+        # Lease RPCs run _spawn_worker via run_in_executor: without this
+        # lock two concurrent leases could each see _zygote_proc is None
+        # and Popen two zygotes on one socket path (the second unlinks and
+        # rebinds the first's socket, leaking the first process).
+        self._zygote_lock = threading.Lock()
+        # (last observed log-lease value, local monotonic time first seen)
+        self._log_lease_seen: Tuple[Optional[bytes], float] = (None, 0.0)
         # Versioned resource view (ray_syncer analog): bumped on every
         # availability/demand change, pushed by _resource_sync_loop.
         # The Event exists from construction so bumps before the sync
@@ -283,29 +291,40 @@ class Nodelet:
     async def _claim_component_log_lease(self, ttl: float
                                          ) -> Tuple[bool, bool]:
         """Refresh/claim the component-log-tailing lease. The value is
-        (node_id, wall-clock stamp); a stamp older than ttl — or a legacy/
-        undecodable value — is claimable. kv_cas makes the takeover atomic
-        under concurrent claimants. Returns (leader, took_over): took_over
-        means the key previously named another node, so history already
-        published by the old leader must not be re-shipped."""
+        (node_id, stamp) where the stamp exists only to make each refresh
+        change the bytes: staleness is judged by observing the VALUE
+        unchanged for ttl of LOCAL monotonic time, never by comparing a
+        remote wall-clock stamp against ours (cross-node clock skew > ttl
+        would otherwise create dueling leaders / premature takeover —
+        ADVICE r4). kv_cas makes the takeover atomic under concurrent
+        claimants. Returns (leader, took_over): took_over means the key
+        previously named another node, so history already published by the
+        old leader must not be re-shipped."""
         import pickle
 
         key = "logtail:component_leader"
         me = self.node_id.binary()
         cur = await self._gcs.call("kv_get", key=key)
         owner: Optional[bytes] = None
-        stamp = 0.0
         if cur:
             try:
-                owner, stamp = pickle.loads(cur)
+                owner, _ = pickle.loads(cur)
             except Exception:
-                pass  # legacy first-writer-wins format: treat as stale
-        now = time.time()
-        if owner != me and owner is not None and now - stamp <= ttl:
-            return False, False
-        new = pickle.dumps((me, now))
+                pass  # legacy/undecodable: stale once it stops changing
+        now_m = time.monotonic()
+        if cur is not None and owner != me:
+            seen_val, seen_at = self._log_lease_seen
+            if seen_val != cur:
+                # value moved since our last probe: holder is alive
+                self._log_lease_seen = (cur, now_m)
+                return False, False
+            if now_m - seen_at <= ttl:
+                return False, False
+        new = pickle.dumps((me, time.time()))
         won = bool(await self._gcs.call("kv_cas", key=key,
                                         expect=cur, value=new))
+        if won:
+            self._log_lease_seen = (new, now_m)
         return won, won and cur is not None and owner != me
 
     async def _log_monitor_loop(self) -> None:
@@ -468,29 +487,31 @@ class Nodelet:
         Returns None (→ classic spawn) when the zygote is unavailable."""
         from ray_tpu._private.zygote import spawn_via_zygote
 
-        if self._zygote_proc is not None and self._zygote_proc.poll() is not None:
-            self._zygote_proc = None  # died: restart on next spawn
-        if self._zygote_proc is None:
-            sock = os.path.join(self.session_dir,
-                                f"zygote-{self.node_id.hex()[:8]}.sock")
-            zenv = dict(os.environ)
-            zenv.pop("PALLAS_AXON_POOL_IPS", None)
-            if zenv.get("JAX_PLATFORMS") == "axon":
-                zenv["JAX_PLATFORMS"] = "cpu"
-            zenv["RAY_TPU_ZYGOTE_SOCKET"] = sock
-            repo_root = os.path.dirname(os.path.dirname(
-                os.path.dirname(os.path.abspath(__file__))))
-            zenv["PYTHONPATH"] = (repo_root + os.pathsep
-                                  + zenv.get("PYTHONPATH", ""))
-            self._zygote_sock = sock
-            self._zygote_proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.zygote"],
-                env=zenv, start_new_session=True)
-            deadline = time.monotonic() + 20.0
-            while (not os.path.exists(sock)
-                   and time.monotonic() < deadline
-                   and self._zygote_proc.poll() is None):
-                time.sleep(0.01)
+        with self._zygote_lock:
+            if (self._zygote_proc is not None
+                    and self._zygote_proc.poll() is not None):
+                self._zygote_proc = None  # died: restart on next spawn
+            if self._zygote_proc is None:
+                sock = os.path.join(self.session_dir,
+                                    f"zygote-{self.node_id.hex()[:8]}.sock")
+                zenv = dict(os.environ)
+                zenv.pop("PALLAS_AXON_POOL_IPS", None)
+                if zenv.get("JAX_PLATFORMS") == "axon":
+                    zenv["JAX_PLATFORMS"] = "cpu"
+                zenv["RAY_TPU_ZYGOTE_SOCKET"] = sock
+                repo_root = os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__))))
+                zenv["PYTHONPATH"] = (repo_root + os.pathsep
+                                      + zenv.get("PYTHONPATH", ""))
+                self._zygote_sock = sock
+                self._zygote_proc = subprocess.Popen(
+                    [sys.executable, "-m", "ray_tpu._private.zygote"],
+                    env=zenv, start_new_session=True)
+                deadline = time.monotonic() + 20.0
+                while (not os.path.exists(sock)
+                       and time.monotonic() < deadline
+                       and self._zygote_proc.poll() is None):
+                    time.sleep(0.01)
         try:
             return spawn_via_zygote(self._zygote_sock, env, log_path)
         except Exception:

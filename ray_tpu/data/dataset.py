@@ -621,6 +621,11 @@ class Dataset:
 
             @ray_tpu.remote
             def _partition(block: Block, key=key, P=P):
+                if not block or not block_num_rows(block):
+                    # empty upstream block (e.g. a filter that dropped
+                    # everything): every partition gets its empty schema
+                    empty = {k: np.asarray(v)[:0] for k, v in block.items()}
+                    return tuple(dict(empty) for _ in _range(P))
                 vals = block[key]
                 codes = _stable_hash_codes(vals, P)
                 return tuple(
@@ -653,30 +658,46 @@ class Dataset:
             rrefs = list(_exec_stream(list(right._plan)))
 
             @ray_tpu.remote
-            def _cols(b: Block):
-                return list(b.keys())
+            def _schema(b: Block):
+                import numpy as np
+                return [(c, str(np.asarray(v).dtype)) for c, v in b.items()]
 
-            # Schema hints (column NAMES only — no payload): an empty
+            # Schema hints (column name + dtype — no payload): an empty
             # partition on one side must still produce the full merged
-            # schema, or downstream block_concat sees inconsistent blocks.
-            def side_cols(refs) -> List[str]:
-                for cols in ray_tpu.get([_cols.remote(r) for r in refs]):
-                    if cols:
-                        return cols
-                return [on]
+            # schema WITH matching key dtypes, or pd.merge raises on e.g.
+            # int64-vs-object key columns and downstream block_concat sees
+            # inconsistent blocks.
+            def side_schema(refs, other_refs):
+                for sch in ray_tpu.get([_schema.remote(r) for r in refs]):
+                    if sch:
+                        return sch
+                # Whole side empty: payload columns are unknowable, but the
+                # key column must still merge cleanly — borrow its dtype
+                # from the other side.
+                for sch in ray_tpu.get(
+                        [_schema.remote(r) for r in other_refs]):
+                    for c, dt in sch:
+                        if c == on:
+                            return [(on, dt)]
+                return [(on, "int64")]
 
-            lcols, rcols = side_cols(lrefs), side_cols(rrefs)
+            lsch = side_schema(lrefs, rrefs)
+            rsch = side_schema(rrefs, lrefs)
 
             @ray_tpu.remote
             def _join_part(lb: Block, rb: Block, on=on, how=how,
-                           lcols=tuple(lcols), rcols=tuple(rcols)) -> Block:
+                           lsch=tuple(lsch), rsch=tuple(rsch)) -> Block:
+                import numpy as np
                 import pandas as pd
 
-                ldf = (pd.DataFrame(dict(lb)) if lb
-                       else pd.DataFrame({c: [] for c in lcols}))
-                rdf = (pd.DataFrame(dict(rb)) if rb
-                       else pd.DataFrame({c: [] for c in rcols}))
-                out = ldf.merge(rdf, on=on, how=how)
+                def frame(b, sch):
+                    if b:
+                        return pd.DataFrame(dict(b))
+                    return pd.DataFrame(
+                        {c: np.empty(0, dtype=np.dtype(dt))
+                         for c, dt in sch})
+
+                out = frame(lb, lsch).merge(frame(rb, rsch), on=on, how=how)
                 return {c: out[c].to_numpy() for c in out.columns}
 
             return [_join_part.remote(l, r)

@@ -701,14 +701,26 @@ class _GroupLease:
         self._refresher: Optional[asyncio.Task] = None
 
     async def acquire(self) -> None:
+        # Staleness = the VALUE unchanged for TTL of LOCAL monotonic time
+        # (the stamp inside only makes each holder refresh change the
+        # bytes). Comparing a remote wall-clock stamp against our clock
+        # would let cross-node skew > TTL trigger takeover mid-transfer
+        # (ADVICE r4).
         gcs = self.worker.gcs_client
+        seen: Optional[bytes] = None
+        seen_at = 0.0
         while True:
             cur = await gcs.call("kv_get", key=self.key)
-            stale = True
-            if cur:
+            if cur is not None and cur != seen:
+                seen, seen_at = cur, time.monotonic()
+            stale = (cur is not None
+                     and time.monotonic() - seen_at > self.TTL)
+            if cur is not None and not stale:
+                # release() tombstones with owner=None — claimable now,
+                # no TTL wait for an orderly handoff.
                 try:
-                    _, stamp = pickle.loads(cur)
-                    stale = time.time() - stamp > self.TTL
+                    owner, _ = pickle.loads(cur)
+                    stale = owner is None
                 except Exception:
                     pass
             if cur is None or stale:

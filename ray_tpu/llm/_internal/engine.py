@@ -480,8 +480,17 @@ class LLMEngine:
         tokens stay on device until every batch is in flight, so TTFT for
         N admissions is ~one weight stream + one host sync."""
         admitted = False
-        # bucket -> list of (slot, req, suffix_ids, cached_len, S)
-        waves: Dict[int, List[Tuple[int, Request, Any, int, int]]] = {}
+        # Flat admission-order list of (slot, req, suffix_ids, cached_len,
+        # S, bucket, deps). deps = admission indices of SAME-WAVE requests
+        # whose prefill must be dispatched first: a sharer attends over
+        # pages its owner's prefill writes, and the write only becomes
+        # visible through the self.caches chain once the owner's batch has
+        # been dispatched. Owner and sharer in one batched prefill would
+        # race (the sharer reads the pre-wave input cache), so dispatch
+        # below splits buckets into dependency-respecting sub-batches.
+        entries: List[Tuple[int, Request, Any, int, int, int, set]] = []
+        # page id -> admission index of the request whose prefill writes it
+        wave_page_owner: Dict[int, int] = {}
         ps = self.cache_cfg.page_size
         while self.waiting and self._free_slots:
             req: Request = self.waiting[0]
@@ -533,19 +542,35 @@ class LLMEngine:
             self.temps[slot] = req.temperature
             self.lora_idx[slot] = self.lora_slot(req.lora_id) \
                 if self.lora_banks is not None else 0
+            idx = len(entries)
+            deps = {wave_page_owner[p] for p in shared
+                    if p in wave_page_owner}
             if self.prefix_cache is not None and digests:
-                # Index this prompt's full pages (materialized in program
-                # order by the wave dispatch below) for future requests;
-                # no-op for runs already cached.
+                # Index this prompt's full pages for future requests;
+                # no-op for runs already cached. Pages past the shared
+                # prefix are written by THIS request's prefill — record
+                # ownership so later same-wave sharers order after us.
                 n_full = len(digests)
-                self.prefix_cache.insert(
-                    digests, self.allocator.slot_pages[slot][:n_full])
+                slot_pages = self.allocator.slot_pages[slot]
+                self.prefix_cache.insert(digests, slot_pages[:n_full])
+                for p in slot_pages[len(shared):n_full]:
+                    wave_page_owner[p] = idx
             self.seq_lens[slot] = T
             req.generated = 1
-            waves.setdefault(bucket, []).append(
-                (slot, req, suffix, cached_len, S))
+            entries.append((slot, req, suffix, cached_len, S, bucket, deps))
         pending: List[Tuple[int, Request, Any, int]] = []
-        for bucket, wave in waves.items():
+        # Dispatch in dependency-respecting sub-batches: repeatedly take
+        # the earliest undispatched admission, batch it with every other
+        # undispatched same-bucket entry whose deps are all dispatched.
+        # deps always point to earlier admissions, so the earliest
+        # remaining entry is always dispatchable (no deadlock).
+        done: set = set()
+        remaining = list(range(len(entries)))
+        while remaining:
+            bucket = entries[remaining[0]][5]
+            batch = [j for j in remaining
+                     if entries[j][5] == bucket and entries[j][6] <= done]
+            wave = [entries[j][:5] for j in batch]
             nb = len(wave)
             ids = np.zeros((nb, bucket), np.int32)
             rows = np.zeros((nb, self.cfg.max_pages_per_seq), np.int32)
@@ -568,6 +593,8 @@ class LLMEngine:
                 self._dev(lidx))
             for i, (slot, req, _, _, _) in enumerate(wave):
                 pending.append((slot, req, dev_toks, i))
+            done.update(batch)
+            remaining = [j for j in remaining if j not in done]
         for slot, req, dev_toks, i in pending:
             tok = int(np.asarray(dev_toks)[i])  # sync: all waves in flight
             self.last_tokens[slot] = tok

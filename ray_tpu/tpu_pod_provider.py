@@ -284,22 +284,59 @@ class GceQueuedResourceTransport(TPUTransport):
             name=f"tpu-qr-poll-{name}",
             args=(name, cfg, on_active, on_failed)).start()
 
+    # A transient HTTP/network blip must not abandon a QR that may still
+    # go ACTIVE in the cloud (and keep billing with no local record):
+    # retry with backoff for a bounded window, and on ANY terminal
+    # failure issue a DELETE so the abandoned QR is actually released
+    # (ADVICE r4).
+    poll_error_window_s = 300.0
+
+    def _fail_and_release(self, name, on_failed, reason: str) -> None:
+        self.delete_queued_resource(name, [])
+        on_failed(reason)
+
     def _poll_until_active(self, name, cfg, on_active, on_failed):
+        first_error: Optional[float] = None
+        first_fetch_error: Optional[float] = None
+        backoff = self.poll_interval_s
+        fetch_backoff = self.poll_interval_s
         while name not in self._deleted:
             try:
                 resp = self.session.get(self._qr_url(cfg, name))
+                if resp.status_code >= 500 or resp.status_code == 429:
+                    raise RuntimeError(f"HTTP {resp.status_code}")
                 state = (resp.json().get("state") or {}).get("state", "")
             except Exception as e:  # noqa: BLE001
-                on_failed(f"queuedResource poll error: {e!r}")
-                return
+                now = time.monotonic()
+                first_error = first_error if first_error is not None else now
+                if now - first_error > self.poll_error_window_s:
+                    self._fail_and_release(
+                        name, on_failed,
+                        f"queuedResource poll error (gave up after "
+                        f"{self.poll_error_window_s:.0f}s): {e!r}")
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 30.0)
+                continue
+            first_error, backoff = None, self.poll_interval_s
             if state in ("FAILED", "SUSPENDED", "SUSPENDING"):
-                on_failed(f"queuedResource state {state}")
+                self._fail_and_release(
+                    name, on_failed, f"queuedResource state {state}")
                 return
             if state == "ACTIVE":
                 backings = self._fetch_host_backings(name, cfg)
                 if backings is None:
-                    on_failed("slice node vanished after ACTIVE")
-                    return
+                    if first_fetch_error is None:
+                        first_fetch_error = time.monotonic()
+                    if time.monotonic() - first_fetch_error \
+                            > self.poll_error_window_s:
+                        self._fail_and_release(
+                            name, on_failed,
+                            "slice node unfetchable after ACTIVE")
+                        return
+                    time.sleep(fetch_backoff)
+                    fetch_backoff = min(fetch_backoff * 2, 30.0)
+                    continue
                 on_active(backings)
                 return
             time.sleep(self.poll_interval_s)

@@ -117,3 +117,30 @@ def test_write_csv_and_json_block_parallel(ray_start_regular, tmp_path):
         with open(tmp_path / "json" / p) as f:
             jrows += [json.loads(ln) for ln in f]
     assert sorted(r["x"] for r in jrows) == list(range(20))
+
+
+def test_join_skewed_keys_empty_partitions(ray_start_regular):
+    """Few/skewed int keys leave some hash partitions empty on exactly one
+    side; empty partitions must materialize with the non-empty side's key
+    DTYPE (not object) or pd.merge raises, and payload columns must
+    survive (ADVICE r4)."""
+    left = rd.from_items([{"k": 1, "lv": float(i)} for i in range(6)])
+    right = rd.from_items([{"k": k, "rv": k * 10.0} for k in (1, 2, 3)])
+    out = left.join(right, on="k", how="outer", num_partitions=8)
+    got = out.to_pandas().sort_values(["k"]).reset_index(drop=True)
+    assert sorted(got.columns) == ["k", "lv", "rv"]
+    # all six left rows matched k=1; unmatched right keys 2,3 present
+    assert (got["k"] == 1).sum() == 6
+    assert set(got["k"]) == {1, 2, 3}
+
+
+def test_join_one_side_entirely_empty(ray_start_regular):
+    """A fully-empty side used to collapse its schema to just the key
+    column with object dtype; the merge must still run."""
+    left = rd.from_items([{"k": i, "lv": float(i)} for i in range(4)])
+    right = rd.from_items([{"k": 0, "rv": 1.0}]).filter(
+        lambda row: False)
+    out = left.join(right, on="k", how="left", num_partitions=4)
+    got = out.to_pandas().sort_values(["k"]).reset_index(drop=True)
+    assert (got["k"].to_numpy() == np.arange(4)).all()
+    assert len(got) == 4

@@ -167,3 +167,51 @@ def test_pipelined_staggered_admission(tiny_model):
         steps += 1
     assert got["a"] == oracle_greedy(model, params, [5, 17, 42, 7], 10)
     assert got["b"] == oracle_greedy(model, params, [9, 3, 11], 10)
+
+
+def test_same_wave_sharing_dispatch_order(tiny_model):
+    """Requests admitted in ONE wave that share pages must still be
+    token-exact: the sharer's prefill reads KV pages the owner's prefill
+    writes, so the owner must be dispatched in a strictly earlier prefill
+    batch (ADVICE r4 high: wave dispatch in bucket-creation order could
+    run the sharer first — or batch owner+sharer together, which races
+    on the pre-wave input cache either way)."""
+    model, params = tiny_model
+    ps = 4
+    common = [5, 17, 42, 7, 9, 3, 11, 2]  # 2 full pages
+    # req0: unrelated, SHORT suffix -> creates the small bucket first.
+    # req1: owner, long prompt -> large bucket.
+    # req2: shares req1's 2 prefix pages, short suffix -> SMALL bucket.
+    # Bucket-creation-order dispatch would prefill req2 before req1.
+    p0 = [60, 61, 62]
+    p1 = common + [21, 33, 44, 55, 66, 77, 88, 99, 13]  # S=17 -> bucket 32
+    p2 = common + [44]  # suffix len 1 after 2-page hit -> bucket 8
+    eng = LLMEngine(model, params, EngineConfig(
+        max_seqs=4, page_size=ps, max_pages_per_seq=16, decode_steps=2,
+        prefill_buckets=(8, 32)))
+    eng.add_request(Request("r0", p0, max_tokens=4))
+    eng.add_request(Request("r1", p1, max_tokens=4))
+    eng.add_request(Request("r2", p2, max_tokens=4))
+    got = drain(eng)
+    assert got["r0"] == oracle_greedy(model, params, p0, 4)
+    assert got["r1"] == oracle_greedy(model, params, p1, 4)
+    assert got["r2"] == oracle_greedy(model, params, p2, 4)
+
+
+def test_same_wave_same_bucket_owner_sharer(tiny_model):
+    """Owner and sharer whose suffixes land in the SAME bucket must not be
+    batched into one prefill call — the sharer would read the pre-wave
+    cache, not the owner's writes."""
+    model, params = tiny_model
+    ps = 4
+    common = [5, 17, 42, 7, 9, 3, 11, 2]
+    p1 = common + [21]          # owner: S=9
+    p2 = common + [44]          # sharer after 2-page hit: S=1, same bucket 32
+    eng = LLMEngine(model, params, EngineConfig(
+        max_seqs=4, page_size=ps, max_pages_per_seq=16, decode_steps=2,
+        prefill_buckets=(32,)))
+    eng.add_request(Request("a", p1, max_tokens=5))
+    eng.add_request(Request("b", p2, max_tokens=5))
+    got = drain(eng)
+    assert got["a"] == oracle_greedy(model, params, p1, 5)
+    assert got["b"] == oracle_greedy(model, params, p2, 5)

@@ -241,3 +241,113 @@ def test_usage_stats_recorder(tmp_path, monkeypatch):
     monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
     usage.record_library_usage("optout-lib")
     assert "optout-lib" not in usage.usage_snapshot()
+
+
+class FlakyGceSession(FakeGceSession):
+    """Injects transient failures: the first `fail_polls` QR GETs raise /
+     503 before the normal state machine resumes."""
+
+    def __init__(self, fail_polls=2, fail_mode="exc", **kw):
+        super().__init__(**kw)
+        self.fail_polls = fail_polls
+        self.fail_mode = fail_mode
+        self.failed = 0
+
+    def get(self, url):
+        if "/queuedResources/" in url and self.failed < self.fail_polls:
+            self.failed += 1
+            if self.fail_mode == "exc":
+                raise ConnectionError("transient network blip")
+            return _Resp(503, text="backend error")
+        return super().get(url)
+
+
+def test_gce_poll_retries_transient_errors():
+    """A network blip / 5xx while polling must NOT abandon the slice:
+    the poll retries with backoff and still reaches ACTIVE (ADVICE r4)."""
+    import threading as _t
+
+    from ray_tpu.tpu_pod_provider import (
+        GceQueuedResourceTransport,
+        TPUPodConfig,
+    )
+
+    for mode in ("exc", "503"):
+        session = FlakyGceSession(fail_polls=2, fail_mode=mode,
+                                  hosts_per_slice=2, activate_after=1)
+        transport = GceQueuedResourceTransport(
+            session=session, poll_interval_s=0.02)
+        cfg = TPUPodConfig.from_accelerator(
+            "v5litepod-16", project="proj", zone="us-central2-b")
+        got = {}
+        ev = _t.Event()
+        transport.create_queued_resource(
+            "s0", cfg,
+            on_active=lambda b: (got.__setitem__("b", b), ev.set()),
+            on_failed=lambda r: (got.__setitem__("fail", r), ev.set()))
+        assert ev.wait(10), "poll thread never resolved"
+        assert "fail" not in got, got
+        assert len(got["b"]) == 2
+        assert session.failed == 2  # the blips actually happened
+
+
+def test_gce_terminal_failure_releases_qr():
+    """A terminal QR state (or exhausted retry window) must DELETE the
+    queued resource before reporting failure — otherwise an abandoned QR
+    can go ACTIVE in the cloud and bill with no local record."""
+    import threading as _t
+
+    from ray_tpu.tpu_pod_provider import (
+        GceQueuedResourceTransport,
+        TPUPodConfig,
+    )
+
+    class SuspendedSession(FakeGceSession):
+        def get(self, url):
+            name = url.rstrip("/").split("/")[-1]
+            if "/queuedResources/" in url and name in self.qrs:
+                return _Resp(200, {"state": {"state": "SUSPENDED"}})
+            return super().get(url)
+
+    session = SuspendedSession()
+    transport = GceQueuedResourceTransport(
+        session=session, poll_interval_s=0.02)
+    cfg = TPUPodConfig.from_accelerator(
+        "v5litepod-16", project="proj", zone="us-central2-b")
+    got = {}
+    ev = _t.Event()
+    transport.create_queued_resource(
+        "s1", cfg,
+        on_active=lambda b: ev.set(),
+        on_failed=lambda r: (got.__setitem__("fail", r), ev.set()))
+    assert ev.wait(10)
+    assert "SUSPENDED" in got["fail"]
+    assert "s1" in session.delete_calls, \
+        "terminal failure did not release the queued resource"
+
+
+def test_gce_poll_gives_up_after_window_and_releases():
+    """Persistent poll errors exhaust the bounded window, then fail AND
+    delete the QR (bounded, not infinite, retry)."""
+    import threading as _t
+
+    from ray_tpu.tpu_pod_provider import (
+        GceQueuedResourceTransport,
+        TPUPodConfig,
+    )
+
+    session = FlakyGceSession(fail_polls=10 ** 9, hosts_per_slice=1)
+    transport = GceQueuedResourceTransport(
+        session=session, poll_interval_s=0.01)
+    transport.poll_error_window_s = 0.1
+    cfg = TPUPodConfig.from_accelerator(
+        "v5litepod-16", project="proj", zone="us-central2-b")
+    got = {}
+    ev = _t.Event()
+    transport.create_queued_resource(
+        "s2", cfg,
+        on_active=lambda b: ev.set(),
+        on_failed=lambda r: (got.__setitem__("fail", r), ev.set()))
+    assert ev.wait(10)
+    assert "gave up" in got["fail"]
+    assert "s2" in session.delete_calls
