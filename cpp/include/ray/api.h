@@ -1,0 +1,305 @@
+// ray_tpu typed C++ API (reference surface: cpp/include/ray/api.h —
+// ray::Init / ray::Put / ray::Get / ray::Task(fn).Remote(...) /
+// ray::Actor(factory).Remote(...) / ActorHandle<T>.Task(&T::M).Remote()).
+//
+// Architecture (deliberately different from the reference's gRPC+protobuf
+// C++ worker): this header speaks the xlang command plane of
+// ray_tpu/xlang/server.py (ops 8-10) for scheduling, and hosts an
+// in-process Executor (internal/executor.h) that the cluster's
+// task/actor bodies dial back into to run the registered C++ functions —
+// the driver binary IS the C++ worker. Scheduling, dependency
+// resolution (ObjectRef args), per-actor ordering and fault surfaces all
+// ride the normal cluster paths; only the function body executes here.
+//
+//   #include <ray/api.h>
+//   int Plus(int a, int b) { return a + b; }
+//   RAY_REMOTE(Plus);
+//   ...
+//   ray::Init("127.0.0.1", port);
+//   auto obj = ray::Put(100);
+//   int v = *ray::Get(obj);
+//   auto ref = ray::Task(Plus).Remote(1, 2);
+//   int sum = *ray::Get(ref);
+//   ray::ActorHandle<Counter> a = ray::Actor(Counter::Create).Remote(0);
+//   int c = *ray::Get(a.Task(&Counter::Add).Remote(3));
+//   ray::Shutdown();
+
+#pragma once
+
+#include <memory>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <type_traits>
+#include <utility>
+#include <vector>
+
+#include "internal/executor.h"
+#include "internal/registry.h"
+#include "internal/wire.h"
+#include "serializer.h"
+
+namespace ray {
+
+template <typename T>
+class ObjectRef {
+ public:
+  ObjectRef() = default;
+  explicit ObjectRef(std::string id) : id_(std::move(id)) {}
+  const std::string& ID() const { return id_; }
+  bool IsNil() const { return id_.empty(); }
+
+ private:
+  std::string id_;
+};
+
+namespace internal {
+
+// Command-plane op codes (must match ray_tpu/xlang/server.py).
+enum CmdOp : uint8_t {
+  kPut = 2,
+  kGet = 3,
+  kRelease = 7,
+  kExecTask = 8,
+  kExecActorNew = 9,
+  kExecActorCall = 10,
+};
+
+struct Runtime {
+  int cmd_fd = -1;
+  std::mutex mu;            // one in-flight command at a time
+  Executor executor;
+  std::string exec_addr;    // "ip:port" the cluster dials back to
+  bool inited = false;
+
+  static Runtime& Instance() {
+    static Runtime r;
+    return r;
+  }
+
+  std::string Command(uint8_t op, const std::string& body) {
+    std::lock_guard<std::mutex> g(mu);
+    if (!inited) throw std::runtime_error("ray: call ray::Init() first");
+    SendFrame(cmd_fd, op, body);
+    uint8_t status;
+    std::string out;
+    if (!RecvFrame(cmd_fd, &status, &out))
+      throw std::runtime_error("ray: server closed connection");
+    if (status != 0) throw std::runtime_error("ray: " + out);
+    return out;
+  }
+};
+
+// -- argument packing -------------------------------------------------------
+// Wire: u32 nargs | { u8 kind(0=value,1=ref) | u32 len | data }...
+
+template <typename T>
+struct IsObjectRef : std::false_type {};
+template <typename T>
+struct IsObjectRef<ObjectRef<T>> : std::true_type {};
+
+template <typename Param, typename Arg>
+void PackOne(std::string& out, const Arg& a) {
+  using A = std::decay_t<Arg>;
+  if constexpr (IsObjectRef<A>::value) {
+    out.push_back(1);
+    PutU32(out, static_cast<uint32_t>(a.ID().size()));
+    out += a.ID();
+  } else {
+    std::string v = Encode<std::decay_t<Param>>(
+        static_cast<std::decay_t<Param>>(a));
+    out.push_back(0);
+    PutU32(out, static_cast<uint32_t>(v.size()));
+    out += v;
+  }
+}
+
+template <typename... Params, typename... Args>
+std::string PackArgs(const Args&... args) {
+  static_assert(sizeof...(Params) == sizeof...(Args),
+                "ray: wrong number of arguments for remote call");
+  std::string out;
+  PutU32(out, static_cast<uint32_t>(sizeof...(Args)));
+  (PackOne<Params>(out, args), ...);
+  return out;
+}
+
+inline std::string Named(const std::string& addr_or_id,
+                         const std::string& name,
+                         const std::string& args) {
+  std::string body;
+  AppendU16(body, addr_or_id.size());
+  body += addr_or_id;
+  AppendU16(body, name.size());
+  body += name;
+  body += args;
+  return body;
+}
+
+}  // namespace internal
+
+// -- core API ---------------------------------------------------------------
+
+inline void Init(const std::string& host, int port) {
+  auto& rt = internal::Runtime::Instance();
+  std::lock_guard<std::mutex> g(rt.mu);
+  if (rt.inited) return;
+  rt.cmd_fd = internal::ConnectTcp(host, port);
+  int exec_port = rt.executor.Start();
+  // The address cluster workers dial back: our IP on the route to the
+  // server (multi-host safe), plus the executor's port.
+  sockaddr_in local{};
+  socklen_t len = sizeof(local);
+  ::getsockname(rt.cmd_fd, reinterpret_cast<sockaddr*>(&local), &len);
+  char ip[INET_ADDRSTRLEN];
+  ::inet_ntop(AF_INET, &local.sin_addr, ip, sizeof(ip));
+  rt.exec_addr = std::string(ip) + ":" + std::to_string(exec_port);
+  rt.inited = true;
+}
+
+inline void Shutdown() {
+  auto& rt = internal::Runtime::Instance();
+  std::lock_guard<std::mutex> g(rt.mu);
+  if (!rt.inited) return;
+  ::close(rt.cmd_fd);
+  rt.cmd_fd = -1;
+  rt.inited = false;
+  rt.executor.Stop();
+}
+
+template <typename T>
+ObjectRef<T> Put(const T& value) {
+  auto& rt = internal::Runtime::Instance();
+  return ObjectRef<T>(
+      rt.Command(internal::kPut, internal::Encode<T>(value)));
+}
+
+template <typename T>
+std::shared_ptr<T> Get(const ObjectRef<T>& ref) {
+  auto& rt = internal::Runtime::Instance();
+  std::string bytes = rt.Command(internal::kGet, ref.ID());
+  return std::make_shared<T>(internal::Decode<T>(bytes));
+}
+
+template <typename T>
+std::vector<std::shared_ptr<T>> Get(const std::vector<ObjectRef<T>>& refs) {
+  std::vector<std::shared_ptr<T>> out;
+  out.reserve(refs.size());
+  for (const auto& r : refs) out.push_back(Get(r));
+  return out;
+}
+
+// Drop the server-side pin (see xlang/server.py: the disconnect reaper is
+// the backstop; long-lived drivers should release refs they are done with).
+template <typename T>
+void Release(const ObjectRef<T>& ref) {
+  internal::Runtime::Instance().Command(internal::kRelease, ref.ID());
+}
+
+// -- tasks ------------------------------------------------------------------
+
+template <typename F>
+class TaskCaller;
+
+template <typename R, typename... Params>
+class TaskCaller<R (*)(Params...)> {
+ public:
+  explicit TaskCaller(R (*fn)(Params...)) : fn_(fn) {}
+
+  template <typename... Args>
+  ObjectRef<R> Remote(const Args&... args) {
+    auto& rt = internal::Runtime::Instance();
+    std::string id = rt.Command(
+        internal::kExecTask,
+        internal::Named(rt.exec_addr, internal::NameOf(fn_),
+                        internal::PackArgs<Params...>(args...)));
+    return ObjectRef<R>(id);
+  }
+
+ private:
+  R (*fn_)(Params...);
+};
+
+template <typename R, typename... Params>
+TaskCaller<R (*)(Params...)> Task(R (*fn)(Params...)) {
+  return TaskCaller<R (*)(Params...)>(fn);
+}
+
+// -- actors -----------------------------------------------------------------
+
+template <typename C>
+class ActorHandle;
+
+template <typename M>
+class ActorTaskCaller;
+
+template <typename R, typename C, typename... Params>
+class ActorTaskCaller<R (C::*)(Params...)> {
+ public:
+  ActorTaskCaller(std::string actor_id, R (C::*m)(Params...))
+      : actor_id_(std::move(actor_id)), m_(m) {}
+
+  template <typename... Args>
+  ObjectRef<R> Remote(const Args&... args) {
+    auto& rt = internal::Runtime::Instance();
+    std::string id = rt.Command(
+        internal::kExecActorCall,
+        internal::Named(actor_id_, internal::NameOf(m_),
+                        internal::PackArgs<Params...>(args...)));
+    return ObjectRef<R>(id);
+  }
+
+ private:
+  std::string actor_id_;
+  R (C::*m_)(Params...);
+};
+
+template <typename C>
+class ActorHandle {
+ public:
+  ActorHandle() = default;
+  explicit ActorHandle(std::string id) : id_(std::move(id)) {}
+  const std::string& ID() const { return id_; }
+
+  template <typename R, typename... Params>
+  ActorTaskCaller<R (C::*)(Params...)> Task(R (C::*m)(Params...)) const {
+    return ActorTaskCaller<R (C::*)(Params...)>(id_, m);
+  }
+
+  // Kill the cluster-side proxy and release this handle's pin.
+  void Kill() const {
+    internal::Runtime::Instance().Command(internal::kRelease, id_);
+  }
+
+ private:
+  std::string id_;
+};
+
+template <typename F>
+class ActorCreator;
+
+template <typename C, typename... Params>
+class ActorCreator<C* (*)(Params...)> {
+ public:
+  explicit ActorCreator(C* (*factory)(Params...)) : factory_(factory) {}
+
+  template <typename... Args>
+  ActorHandle<C> Remote(const Args&... args) {
+    auto& rt = internal::Runtime::Instance();
+    std::string id = rt.Command(
+        internal::kExecActorNew,
+        internal::Named(rt.exec_addr, internal::NameOf(factory_),
+                        internal::PackArgs<Params...>(args...)));
+    return ActorHandle<C>(id);
+  }
+
+ private:
+  C* (*factory_)(Params...);
+};
+
+template <typename C, typename... Params>
+ActorCreator<C* (*)(Params...)> Actor(C* (*factory)(Params...)) {
+  return ActorCreator<C* (*)(Params...)>(factory);
+}
+
+}  // namespace ray

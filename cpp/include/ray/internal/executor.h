@@ -1,0 +1,199 @@
+// Executor server: the piece that makes this binary the cluster's C++
+// worker (reference: cpp/src/ray/worker — the reference SPAWNS workers
+// from the app binary; ray_tpu instead has the cluster's Python
+// task/actor bodies dial BACK here, since the compiled function bodies
+// exist nowhere else).
+//
+// Wire (server side of ray_tpu/xlang/server.py's _exec_rpc):
+//   request  := u32 body_len | u8 op | body
+//   response := u32 body_len | u8 status | body     (0=ok, 1=error)
+//   op 1 CALL_FN      : u16 nlen | name | u32 nargs | {u32 len | bytes}...
+//   op 2 NEW_INSTANCE : same shape (factory name)   -> u64 BE instance id
+//   op 3 CALL_METHOD  : u64 iid | u16 mlen | method | u32 nargs | {...}
+//   op 4 DEL_INSTANCE : u64 iid
+//
+// Concurrency: one thread per connection; per-actor ordering is enforced
+// cluster-side (each C++ actor is one Python proxy actor), so the
+// instance table only needs a mutex.
+
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <thread>
+#include <utility>
+#include <vector>
+
+#include "registry.h"
+#include "wire.h"
+
+namespace ray {
+namespace internal {
+
+class Executor {
+ public:
+  // Listens on an ephemeral port; returns it.
+  int Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) throw std::runtime_error("ray: socket() failed");
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = 0;
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      throw std::runtime_error("ray: executor bind failed");
+    if (::listen(listen_fd_, 64) != 0)
+      throw std::runtime_error("ray: executor listen failed");
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return ntohs(addr.sin_port);
+  }
+
+  void Stop() {
+    stopping_ = true;
+    if (listen_fd_ >= 0) {
+      ::shutdown(listen_fd_, SHUT_RDWR);
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : conn_threads_)
+      if (t.joinable()) t.join();
+    conn_threads_.clear();
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& kv : instances_) kv.second.second(kv.second.first);
+    instances_.clear();
+  }
+
+  ~Executor() { Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stopping_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;  // listener closed
+      conn_threads_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    uint8_t op;
+    std::string body;
+    try {
+      while (RecvFrame(fd, &op, &body)) {
+        std::string out;
+        uint8_t status = 0;
+        try {
+          out = Dispatch(op, body);
+        } catch (const std::exception& e) {
+          out = e.what();
+          status = 1;
+        }
+        SendFrame(fd, status, out);
+      }
+    } catch (...) {
+      // torn connection mid-frame: drop it
+    }
+    ::close(fd);
+  }
+
+  static std::pair<std::string, const char*> ReadName(const char* p,
+                                                      const char* end) {
+    if (end - p < 2) throw std::runtime_error("ray: truncated name");
+    size_t n = (static_cast<uint8_t>(p[0]) << 8) |
+               static_cast<uint8_t>(p[1]);
+    p += 2;
+    if (static_cast<size_t>(end - p) < n)
+      throw std::runtime_error("ray: truncated name");
+    return {std::string(p, p + n), p + n};
+  }
+
+  static ArgList ReadArgs(const char* p, const char* end) {
+    uint32_t n = ReadU32(p, end);
+    ArgList args;
+    args.reserve(n);
+    for (uint32_t i = 0; i < n; ++i) {
+      uint32_t ln = ReadU32(p, end);
+      if (static_cast<size_t>(end - p) < ln)
+        throw std::runtime_error("ray: truncated arg");
+      args.emplace_back(p, p + ln);
+      p += ln;
+    }
+    return args;
+  }
+
+  std::string Dispatch(uint8_t op, const std::string& body) {
+    const char* p = body.data();
+    const char* end = p + body.size();
+    auto& reg = Registry::Instance();
+    if (op == 1) {  // CALL_FN
+      auto [name, rest] = ReadName(p, end);
+      auto it = reg.fns.find(name);
+      if (it == reg.fns.end())
+        throw std::runtime_error("ray: unknown remote function " + name);
+      return it->second(ReadArgs(rest, end));
+    }
+    if (op == 2) {  // NEW_INSTANCE
+      auto [name, rest] = ReadName(p, end);
+      auto it = reg.factories.find(name);
+      if (it == reg.factories.end())
+        throw std::runtime_error("ray: unknown actor factory " + name);
+      void* obj = it->second(ReadArgs(rest, end));
+      uint64_t iid = next_iid_++;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        instances_[iid] = {obj, reg.deleters.at(name)};
+      }
+      std::string out;
+      PutU64(out, iid);
+      return out;
+    }
+    if (op == 3) {  // CALL_METHOD
+      uint64_t iid = ReadU64(p, end);
+      auto [name, rest] = ReadName(p, end);
+      auto it = reg.methods.find(name);
+      if (it == reg.methods.end())
+        throw std::runtime_error("ray: unknown actor method " + name);
+      void* obj;
+      {
+        std::lock_guard<std::mutex> g(mu_);
+        auto iit = instances_.find(iid);
+        if (iit == instances_.end())
+          throw std::runtime_error("ray: dead actor instance");
+        obj = iit->second.first;
+      }
+      return it->second(obj, ReadArgs(rest, end));
+    }
+    if (op == 4) {  // DEL_INSTANCE
+      uint64_t iid = ReadU64(p, end);
+      std::lock_guard<std::mutex> g(mu_);
+      auto iit = instances_.find(iid);
+      if (iit != instances_.end()) {
+        iit->second.second(iit->second.first);
+        instances_.erase(iit);
+      }
+      return std::string();
+    }
+    throw std::runtime_error("ray: unknown executor op");
+  }
+
+  int listen_fd_ = -1;
+  std::atomic<bool> stopping_{false};
+  std::thread accept_thread_;
+  std::vector<std::thread> conn_threads_;
+  std::mutex mu_;
+  std::map<uint64_t, std::pair<void*, std::function<void(void*)>>>
+      instances_;
+  std::atomic<uint64_t> next_iid_{1};
+};
+
+}  // namespace internal
+}  // namespace ray

@@ -1,0 +1,155 @@
+// Remote-callable registry for the ray_tpu C++ API (reference: the
+// RAY_REMOTE registration machinery of cpp/include/ray/api.h — function
+// bodies are looked up BY NAME when the cluster bounces execution back
+// into this binary; see ../executor.h).
+//
+// RAY_REMOTE(Plus) / RAY_REMOTE(Counter::FactoryCreate, &Counter::Add)
+// stringizes its arguments and pairs each name with its callable:
+// - free function  R(*)(Args...)            -> task invoker
+// - factory        C*(*)(Args...)           -> actor factory (+deleter)
+// - member         R(C::*)(Args...)         -> actor method invoker
+// ray::Task(fn) / actor.Task(&C::M) recover the registered name from the
+// raw pointer bytes (type-erased key), so call sites never spell names.
+
+#pragma once
+
+#include <cstring>
+#include <functional>
+#include <map>
+#include <stdexcept>
+#include <string>
+#include <tuple>
+#include <utility>
+#include <vector>
+
+#include "../serializer.h"
+
+namespace ray {
+namespace internal {
+
+using ArgList = std::vector<std::string>;
+using Invoker = std::function<std::string(const ArgList&)>;
+using FactoryInvoker = std::function<void*(const ArgList&)>;
+using MethodInvoker = std::function<std::string(void*, const ArgList&)>;
+
+struct Registry {
+  std::map<std::string, Invoker> fns;
+  std::map<std::string, FactoryInvoker> factories;
+  std::map<std::string, std::function<void(void*)>> deleters;  // by factory
+  std::map<std::string, MethodInvoker> methods;
+  std::map<std::string, std::string> name_by_key;  // ptr bytes -> name
+
+  static Registry& Instance() {
+    static Registry r;
+    return r;
+  }
+};
+
+template <typename F>
+std::string KeyOf(F f) {
+  // Function/member pointers are not void*-convertible; their object
+  // representation is still a stable identity within one binary.
+  return std::string(reinterpret_cast<const char*>(&f), sizeof(F));
+}
+
+template <typename F>
+const std::string& NameOf(F f) {
+  auto& m = Registry::Instance().name_by_key;
+  auto it = m.find(KeyOf(f));
+  if (it == m.end())
+    throw std::runtime_error(
+        "ray: callable not declared with RAY_REMOTE(...)");
+  return it->second;
+}
+
+template <typename Tuple, size_t... I>
+Tuple DecodeTuple(const ArgList& in, std::index_sequence<I...>) {
+  if (in.size() != sizeof...(I))
+    throw std::runtime_error("ray: arity mismatch (got " +
+                             std::to_string(in.size()) + " args)");
+  return Tuple{Decode<std::tuple_element_t<I, Tuple>>(in[I])...};
+}
+
+// -- free function ----------------------------------------------------------
+template <typename R, typename... Args>
+void RegisterOne(const std::string& name, R (*fn)(Args...)) {
+  auto& reg = Registry::Instance();
+  reg.name_by_key[KeyOf(fn)] = name;
+  if constexpr (std::is_pointer<R>::value) {
+    // Factory: returns a heap instance the executor owns from here on.
+    using C = std::remove_pointer_t<R>;
+    reg.factories[name] = [fn](const ArgList& in) -> void* {
+      auto tup = DecodeTuple<std::tuple<std::decay_t<Args>...>>(
+          in, std::index_sequence_for<Args...>{});
+      return static_cast<void*>(std::apply(fn, std::move(tup)));
+    };
+    reg.deleters[name] = [](void* p) { delete static_cast<C*>(p); };
+  } else {
+    reg.fns[name] = [fn](const ArgList& in) -> std::string {
+      auto tup = DecodeTuple<std::tuple<std::decay_t<Args>...>>(
+          in, std::index_sequence_for<Args...>{});
+      if constexpr (std::is_void<R>::value) {
+        std::apply(fn, std::move(tup));
+        return std::string();
+      } else {
+        return Encode<R>(std::apply(fn, std::move(tup)));
+      }
+    };
+  }
+}
+
+// -- member function --------------------------------------------------------
+template <typename R, typename C, typename... Args>
+void RegisterOne(const std::string& name, R (C::*m)(Args...)) {
+  auto& reg = Registry::Instance();
+  reg.name_by_key[KeyOf(m)] = name;
+  reg.methods[name] = [m](void* self, const ArgList& in) -> std::string {
+    auto tup = DecodeTuple<std::tuple<std::decay_t<Args>...>>(
+        in, std::index_sequence_for<Args...>{});
+    C* obj = static_cast<C*>(self);
+    if constexpr (std::is_void<R>::value) {
+      std::apply([obj, m](auto&&... a) { (obj->*m)(a...); },
+                 std::move(tup));
+      return std::string();
+    } else {
+      return Encode<R>(std::apply(
+          [obj, m](auto&&... a) { return (obj->*m)(a...); },
+          std::move(tup)));
+    }
+  };
+}
+
+inline std::vector<std::string> SplitNames(const char* raw) {
+  // "#__VA_ARGS__" of RAY_REMOTE: "Counter::FactoryCreate, &Counter::Add"
+  std::vector<std::string> out;
+  std::string cur;
+  for (const char* p = raw;; ++p) {
+    char c = *p;
+    if (c == ',' || c == '\0') {
+      if (!cur.empty()) out.push_back(cur);
+      cur.clear();
+      if (c == '\0') break;
+    } else if (c != ' ' && c != '&' && c != '\t' && c != '\n') {
+      cur.push_back(c);
+    }
+  }
+  return out;
+}
+
+struct Registrar {
+  template <typename... Fs>
+  Registrar(const char* names, Fs... fs) {
+    auto ns = SplitNames(names);
+    size_t i = 0;
+    (RegisterOne(ns.at(i++), fs), ...);  // comma fold: left-to-right
+  }
+};
+
+}  // namespace internal
+}  // namespace ray
+
+#define RAY_INTERNAL_CONCAT2(a, b) a##b
+#define RAY_INTERNAL_CONCAT(a, b) RAY_INTERNAL_CONCAT2(a, b)
+#define RAY_REMOTE(...)                                              \
+  static ::ray::internal::Registrar RAY_INTERNAL_CONCAT(             \
+      _ray_remote_registrar_, __COUNTER__)(#__VA_ARGS__, __VA_ARGS__)

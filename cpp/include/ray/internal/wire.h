@@ -1,0 +1,89 @@
+// POSIX socket framing shared by the command-plane client and the
+// executor server of the ray_tpu C++ API. Frames are
+// u32(BE) body_len | u8 op/status | body — the same shape as the xlang
+// protocol in ray_tpu/xlang/server.py.
+
+#pragma once
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+
+namespace ray {
+namespace internal {
+
+inline void WriteAll(int fd, const char* p, size_t n) {
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w <= 0) throw std::runtime_error("ray: write() failed");
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+}
+
+inline bool ReadAll(int fd, char* p, size_t n) {
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline void SendFrame(int fd, uint8_t tag, const std::string& body) {
+  uint32_t len = htonl(static_cast<uint32_t>(body.size()));
+  std::string frame(reinterpret_cast<char*>(&len), 4);
+  frame.push_back(static_cast<char>(tag));
+  frame += body;
+  WriteAll(fd, frame.data(), frame.size());
+}
+
+// Returns false on orderly EOF before a frame starts.
+inline bool RecvFrame(int fd, uint8_t* tag, std::string* body) {
+  char head[5];
+  if (!ReadAll(fd, head, 5)) return false;
+  uint32_t blen;
+  std::memcpy(&blen, head, 4);
+  blen = ntohl(blen);
+  *tag = static_cast<uint8_t>(head[4]);
+  body->assign(blen, '\0');
+  if (blen > 0 && !ReadAll(fd, &(*body)[0], blen))
+    throw std::runtime_error("ray: truncated frame");
+  return true;
+}
+
+inline int ConnectTcp(const std::string& host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) throw std::runtime_error("ray: socket() failed");
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    ::close(fd);
+    throw std::runtime_error("ray: bad host " + host);
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    throw std::runtime_error("ray: connect() to " + host + ":" +
+                             std::to_string(port) + " failed");
+  }
+  return fd;
+}
+
+inline void AppendU16(std::string& out, size_t v) {
+  out.push_back(static_cast<char>((v >> 8) & 0xff));
+  out.push_back(static_cast<char>(v & 0xff));
+}
+
+}  // namespace internal
+}  // namespace ray

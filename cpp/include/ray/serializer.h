@@ -1,0 +1,160 @@
+// Typed value serialization for the ray_tpu C++ API (reference: the
+// msgpack-based templated serializer behind cpp/include/ray/api.h's
+// ray::Put<T>/Task(...).Remote(T...) — here a deliberately tiny tagged
+// binary format, since both ends of every value are this same header:
+// values cross the cluster as opaque bytes, exactly like the xlang
+// contract in ray_tpu/xlang/server.py).
+//
+// Wire: u8 tag | payload.
+//   1 i64   : 8-byte big-endian two's complement  (all integral types)
+//   2 f64   : 8-byte IEEE-754 big-endian          (float/double)
+//   3 str   : u32 len | bytes
+//   4 bool  : u8
+//   5 vec   : u32 count | element...              (std::vector<T>)
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <type_traits>
+#include <vector>
+
+namespace ray {
+namespace internal {
+
+enum Tag : uint8_t { kI64 = 1, kF64 = 2, kStr = 3, kBool = 4, kVec = 5 };
+
+inline void PutU32(std::string& out, uint32_t v) {
+  for (int i = 3; i >= 0; --i)
+    out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+
+inline void PutU64(std::string& out, uint64_t v) {
+  for (int i = 7; i >= 0; --i)
+    out.push_back(static_cast<char>((v >> (8 * i)) & 0xff));
+}
+
+inline uint32_t ReadU32(const char*& p, const char* end) {
+  if (end - p < 4) throw std::runtime_error("ray: truncated value");
+  uint32_t v = 0;
+  for (int i = 0; i < 4; ++i) v = (v << 8) | static_cast<uint8_t>(*p++);
+  return v;
+}
+
+inline uint64_t ReadU64(const char*& p, const char* end) {
+  if (end - p < 8) throw std::runtime_error("ray: truncated value");
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | static_cast<uint8_t>(*p++);
+  return v;
+}
+
+inline uint8_t ReadTag(const char*& p, const char* end, uint8_t want) {
+  if (p >= end) throw std::runtime_error("ray: truncated value");
+  uint8_t t = static_cast<uint8_t>(*p++);
+  if (t != want)
+    throw std::runtime_error("ray: type mismatch decoding value (tag " +
+                             std::to_string(t) + " != " +
+                             std::to_string(want) + ")");
+  return t;
+}
+
+template <typename T, typename Enable = void>
+struct Codec;  // unsupported types fail to compile here
+
+template <typename T>
+struct Codec<T, std::enable_if_t<std::is_integral<T>::value &&
+                                 !std::is_same<T, bool>::value>> {
+  static void Write(std::string& out, T v) {
+    out.push_back(static_cast<char>(kI64));
+    PutU64(out, static_cast<uint64_t>(static_cast<int64_t>(v)));
+  }
+  static T Read(const char*& p, const char* end) {
+    ReadTag(p, end, kI64);
+    return static_cast<T>(static_cast<int64_t>(ReadU64(p, end)));
+  }
+};
+
+template <typename T>
+struct Codec<T, std::enable_if_t<std::is_floating_point<T>::value>> {
+  static void Write(std::string& out, T v) {
+    out.push_back(static_cast<char>(kF64));
+    double d = static_cast<double>(v);
+    uint64_t bits;
+    std::memcpy(&bits, &d, 8);
+    PutU64(out, bits);
+  }
+  static T Read(const char*& p, const char* end) {
+    ReadTag(p, end, kF64);
+    uint64_t bits = ReadU64(p, end);
+    double d;
+    std::memcpy(&d, &bits, 8);
+    return static_cast<T>(d);
+  }
+};
+
+template <>
+struct Codec<bool> {
+  static void Write(std::string& out, bool v) {
+    out.push_back(static_cast<char>(kBool));
+    out.push_back(v ? 1 : 0);
+  }
+  static bool Read(const char*& p, const char* end) {
+    ReadTag(p, end, kBool);
+    if (p >= end) throw std::runtime_error("ray: truncated bool");
+    return *p++ != 0;
+  }
+};
+
+template <>
+struct Codec<std::string> {
+  static void Write(std::string& out, const std::string& v) {
+    out.push_back(static_cast<char>(kStr));
+    PutU32(out, static_cast<uint32_t>(v.size()));
+    out += v;
+  }
+  static std::string Read(const char*& p, const char* end) {
+    ReadTag(p, end, kStr);
+    uint32_t n = ReadU32(p, end);
+    if (static_cast<size_t>(end - p) < n)
+      throw std::runtime_error("ray: truncated string");
+    std::string s(p, p + n);
+    p += n;
+    return s;
+  }
+};
+
+template <typename E>
+struct Codec<std::vector<E>> {
+  static void Write(std::string& out, const std::vector<E>& v) {
+    out.push_back(static_cast<char>(kVec));
+    PutU32(out, static_cast<uint32_t>(v.size()));
+    for (const auto& e : v) Codec<E>::Write(out, e);
+  }
+  static std::vector<E> Read(const char*& p, const char* end) {
+    ReadTag(p, end, kVec);
+    uint32_t n = ReadU32(p, end);
+    std::vector<E> v;
+    v.reserve(n);
+    for (uint32_t i = 0; i < n; ++i) v.push_back(Codec<E>::Read(p, end));
+    return v;
+  }
+};
+
+template <typename T>
+std::string Encode(const T& v) {
+  std::string out;
+  Codec<std::decay_t<T>>::Write(out, v);
+  return out;
+}
+
+template <typename T>
+T Decode(const std::string& bytes) {
+  const char* p = bytes.data();
+  const char* end = p + bytes.size();
+  return Codec<std::decay_t<T>>::Read(p, end);
+}
+
+}  // namespace internal
+}  // namespace ray

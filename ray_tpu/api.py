@@ -131,6 +131,14 @@ def shutdown() -> None:
         _global_client.disconnect()
         _global_client = None
         return
+    # Channel-mode DAGs hold pinned actor loops blocked on shm/rpc rings;
+    # leaked ones must die BEFORE workers go away or their driver-side
+    # reader threads can wedge interpreter exit.
+    try:
+        from ray_tpu.dag import teardown_all_channel_dags
+        teardown_all_channel_dags()
+    except Exception:
+        pass
     w = worker_mod.global_worker_or_none()
     if w is not None:
         try:

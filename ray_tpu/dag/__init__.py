@@ -26,6 +26,7 @@ from __future__ import annotations
 import itertools
 import logging
 import time
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
@@ -177,6 +178,12 @@ class CompiledDAG:
                 self._next_out_seq = [0] * len(self._out_readers)
                 self._out_buffer = [{} for _ in self._out_readers]
                 self._channel_mode = True
+                # A leaked channel-mode DAG is dangerous: its pinned
+                # per-actor loops block on rings forever and can wedge
+                # later work (or interpreter exit). Track every live one
+                # so shutdown() — and test fixtures — can tear down what
+                # the owner forgot.
+                _live_channel_dags.add(self)
             except Exception:
                 logger.warning("compiled-DAG channel setup failed; "
                                "falling back to actor-push", exc_info=True)
@@ -564,9 +571,28 @@ class CompiledDAG:
             self._inflight = []
             self._out_buffer = []
             self._teardown_channels()
+        _live_channel_dags.discard(self)
         self._order.clear()
         self._visited.clear()
 
 
+# Live channel-mode DAGs (weak: a collected DAG can't be torn down, and
+# its rings die with the worker processes at shutdown anyway).
+_live_channel_dags: "weakref.WeakSet[CompiledDAG]" = weakref.WeakSet()
+
+
+def teardown_all_channel_dags() -> int:
+    """Tear down every live channel-mode DAG (leak containment: called by
+    ray_tpu.shutdown() and per-test by the suite). Returns the count."""
+    n = 0
+    for dag in list(_live_channel_dags):
+        try:
+            dag.teardown()
+            n += 1
+        except Exception:
+            logger.warning("leaked DAG teardown failed", exc_info=True)
+    return n
+
+
 __all__ = ["CompiledDAG", "CompiledDAGRef", "ClassMethodNode", "DAGNode",
-           "InputNode", "MultiOutputNode"]
+           "InputNode", "MultiOutputNode", "teardown_all_channel_dags"]

@@ -47,6 +47,15 @@ OP_TASK = 4
 OP_ACTOR_NEW = 5
 OP_ACTOR_CALL = 6
 OP_RELEASE = 7  # drop the server-side pin of a PUT/TASK ref
+# Typed C++ API (cpp/include/ray/api.h): tasks/actors whose BODIES live in
+# the C++ driver binary. The cluster schedules a normal task/actor; its
+# Python body dials back into the C++ process's executor server to run
+# the registered function — the compiled code exists nowhere else (the
+# reference solves this by spawning C++ workers from the app binary,
+# cpp/src/ray/worker; here the driver binary IS the C++ worker).
+OP_EXEC_TASK = 8
+OP_EXEC_ACTOR_NEW = 9
+OP_EXEC_ACTOR_CALL = 10
 
 _registry: Dict[str, Callable[[bytes], bytes]] = {}
 _actor_registry: Dict[str, Any] = {}
@@ -69,6 +78,119 @@ class _Session:
     def __init__(self):
         self.pins: Dict[str, Any] = {}    # ref id hex -> ObjectRef
         self.actors: Dict[str, Any] = {}  # actor id hex -> handle
+
+
+# ---------------------------------------------------------------------------
+# Typed C++ executor callback plane.
+#
+# Executor wire (C++ side listens; Python task bodies dial):
+#   request  := u32 body_len | u8 op | body
+#   response := u32 body_len | u8 status | body     (0=ok, 1=error)
+#   op 1 CALL_FN      : u16 nlen | name | u32 nargs | {u32 len | bytes}...
+#   op 2 NEW_INSTANCE : same shape as CALL_FN (factory name) -> u64 BE iid
+#   op 3 CALL_METHOD  : u64 iid | u16 mlen | method | u32 nargs | {...}
+#   op 4 DEL_INSTANCE : u64 iid
+# ---------------------------------------------------------------------------
+
+def _exec_rpc(addr: str, op: int, body: bytes, timeout: float = 600.0
+              ) -> bytes:
+    import socket
+
+    host, _, port = addr.rpartition(":")
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        s.sendall(struct.pack(">I", len(body)) + bytes([op]) + body)
+        head = _recvn(s, 5)
+        (blen,), status = struct.unpack(">I", head[:4]), head[4]
+        out = _recvn(s, blen)
+        if status != 0:
+            raise RuntimeError(f"cpp executor error: {out.decode()}")
+        return out
+
+
+def _recvn(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("cpp executor closed connection")
+        buf += chunk
+    return buf
+
+
+def _pack_fn_call(name: str, args: list) -> bytes:
+    body = struct.pack(">H", len(name)) + name.encode()
+    body += struct.pack(">I", len(args))
+    for a in args:
+        body += struct.pack(">I", len(a)) + bytes(a)
+    return body
+
+
+def _splice(arg_slots, resolved) -> list:
+    """Inline arg bytes stay; None placeholders take the next resolved
+    upstream value (the worker already turned ObjectRefs into bytes)."""
+    it = iter(resolved)
+    out = []
+    for slot in arg_slots:
+        v = next(it) if slot is None else slot
+        if not isinstance(v, (bytes, bytearray, memoryview)):
+            raise TypeError(
+                f"cpp task arg resolved to non-bytes {type(v).__name__}")
+        out.append(bytes(v))
+    return out
+
+
+def _cpp_exec_task_fn(addr, name, arg_slots, *resolved):
+    return _exec_rpc(addr, 1, _pack_fn_call(name, _splice(arg_slots,
+                                                          resolved)))
+
+
+class _CppActorProxyImpl:
+    """Cluster-side stand-in for a C++ actor: owns one instance id in the
+    C++ executor's table; per-actor call ordering comes from the normal
+    actor submission path."""
+
+    def __init__(self, addr, factory, arg_slots, *resolved):
+        self.addr = addr
+        out = _exec_rpc(addr, 2, _pack_fn_call(
+            factory, _splice(arg_slots, resolved)))
+        (self.iid,) = struct.unpack(">Q", out)
+
+    def call(self, method, arg_slots, *resolved):
+        # CALL_METHOD: iid | u16 mlen | method | nargs | args
+        args = _splice(arg_slots, resolved)
+        body = struct.pack(">Q", self.iid)
+        body += struct.pack(">H", len(method)) + method.encode()
+        body += struct.pack(">I", len(args))
+        for a in args:
+            body += struct.pack(">I", len(a)) + a
+        return _exec_rpc(self.addr, 3, body)
+
+    def release(self):
+        try:
+            _exec_rpc(self.addr, 4, struct.pack(">Q", self.iid), timeout=5)
+        except Exception:  # noqa: BLE001
+            pass  # the C++ process may already be gone
+        return b"ok"
+
+
+def _parse_exec_args(buf: bytes, off: int):
+    """u32 nargs | {u8 kind, u32 len, data}...; kind 0 = inline bytes,
+    kind 1 = ref id hex. Returns (slots, ref_hexes): slots has None at
+    ref positions, filled left-to-right from ref_hexes."""
+    (nargs,) = struct.unpack(">I", buf[off:off + 4])
+    off += 4
+    slots, refs = [], []
+    for _ in range(nargs):
+        kind = buf[off]
+        (ln,) = struct.unpack(">I", buf[off + 1:off + 5])
+        data = buf[off + 5:off + 5 + ln]
+        off += 5 + ln
+        if kind == 0:
+            slots.append(bytes(data))
+        else:
+            slots.append(None)
+            refs.append(data.decode())
+    return slots, refs
 
 
 class XlangServer:
@@ -122,6 +244,18 @@ class XlangServer:
     def _named(body: bytes) -> Tuple[str, bytes]:
         (nlen,) = struct.unpack(">H", body[:2])
         return body[2:2 + nlen].decode(), body[2 + nlen:]
+
+    @staticmethod
+    def _named_at(body: bytes, off: int) -> Tuple[str, int]:
+        (nlen,) = struct.unpack(">H", body[off:off + 2])
+        return body[off + 2:off + 2 + nlen].decode(), off + 2 + nlen
+
+    @staticmethod
+    def _ref_of(session: "_Session", ref_hex: str):
+        ref = session.pins.get(ref_hex)
+        if ref is None:
+            raise KeyError(f"unknown xlang ref {ref_hex}")
+        return ref
 
     async def _dispatch(self, op: int, body: bytes,
                         session: _Session) -> bytes:
@@ -186,6 +320,49 @@ class XlangServer:
             if not isinstance(out, (bytes, bytearray, memoryview)):
                 raise TypeError("xlang actor method must return bytes")
             return bytes(out)
+        if op == OP_EXEC_TASK:
+            (alen,) = struct.unpack(">H", body[:2])
+            addr = body[2:2 + alen].decode()
+            name, rest_off = self._named_at(body, 2 + alen)
+            slots, ref_hexes = _parse_exec_args(body, rest_off)
+            dep_refs = [self._ref_of(session, h) for h in ref_hexes]
+
+            def submit():
+                rf = ray_tpu.remote(_cpp_exec_task_fn)
+                return rf.remote(addr, name, slots, *dep_refs)
+
+            ref = await loop.run_in_executor(None, submit)
+            session.pins[ref.id.hex()] = ref
+            return ref.id.hex().encode()
+        if op == OP_EXEC_ACTOR_NEW:
+            (alen,) = struct.unpack(">H", body[:2])
+            addr = body[2:2 + alen].decode()
+            name, rest_off = self._named_at(body, 2 + alen)
+            slots, ref_hexes = _parse_exec_args(body, rest_off)
+            dep_refs = [self._ref_of(session, h) for h in ref_hexes]
+
+            def create():
+                ac = ray_tpu.remote(_CppActorProxyImpl)
+                return ac.remote(addr, name, slots, *dep_refs)
+
+            handle = await loop.run_in_executor(None, create)
+            hexid = handle._actor_id.hex()
+            session.actors[hexid] = handle
+            return hexid.encode()
+        if op == OP_EXEC_ACTOR_CALL:
+            (alen,) = struct.unpack(">H", body[:2])
+            actor_hex = body[2:2 + alen].decode()
+            method, rest_off = self._named_at(body, 2 + alen)
+            slots, ref_hexes = _parse_exec_args(body, rest_off)
+            dep_refs = [self._ref_of(session, h) for h in ref_hexes]
+            handle = session.actors[actor_hex]
+
+            def call():
+                return handle.call.remote(method, slots, *dep_refs)
+
+            ref = await loop.run_in_executor(None, call)
+            session.pins[ref.id.hex()] = ref
+            return ref.id.hex().encode()
         if op == OP_RELEASE:
             # Clients should release refs AND actors they are done with as
             # soon as possible (the disconnect reaper is the backstop, not

@@ -21,6 +21,28 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest
 
+# Out-of-process killer: SIGKILLs this pytest process if a phase wedges
+# past the per-test budget + margin, or if the interpreter fails to exit
+# after the session (leaked non-daemon threads) — states the in-process
+# SIGALRM watchdog below cannot escape.
+pytest_plugins = ["ray_tpu._private.pytest_watchdog"]
+
+
+@pytest.fixture(autouse=True)
+def _reap_leaked_channel_dags():
+    """A test that leaks a channel-mode compiled DAG leaves pinned actor
+    loops blocked on rings that can wedge every later test; contain the
+    blast radius to the leaking test."""
+    yield
+    from ray_tpu.dag import teardown_all_channel_dags
+
+    leaked = teardown_all_channel_dags()
+    if leaked:
+        import warnings
+
+        warnings.warn(f"test leaked {leaked} channel-mode DAG(s); "
+                      "torn down by conftest")
+
 
 @pytest.fixture(scope="module")
 def ray_cluster():

@@ -51,3 +51,39 @@ def test_cpp_driver_end_to_end(ray_start_regular, cpp_driver):
     assert lines["TASK"] == "fedcba"
     assert lines["ACTOR"] == "22"
     assert "CPP-DRIVER-OK" in out.stdout
+
+
+@pytest.fixture(scope="module")
+def cpp_typed_app(tmp_path_factory):
+    out = tmp_path_factory.mktemp("cpp") / "example_app"
+    src = os.path.join(REPO, "cpp", "example_app.cc")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1",
+         "-I", os.path.join(REPO, "cpp", "include"),
+         src, "-o", str(out), "-pthread"],
+        check=True, capture_output=True, text=True)
+    return str(out)
+
+
+def test_cpp_typed_api_end_to_end(ray_start_regular, cpp_typed_app):
+    """The typed surface (reference cpp/include/ray/api.h shape):
+    RAY_REMOTE + Init/Put/Get/Task(fn).Remote/Actor(factory).Remote with
+    value args, ObjectRef dependency args, and actor state — scheduled as
+    real cluster tasks whose bodies bounce back into the C++ binary."""
+    from ray_tpu import xlang
+
+    host, port = xlang.serve_xlang(0)
+    out = subprocess.run([cpp_typed_app, str(port)], capture_output=True,
+                         text=True, timeout=180)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    lines = dict(l.split(" ", 1) for l in out.stdout.splitlines()
+                 if " " in l)
+    assert lines["PUTGET"] == "100"
+    assert lines["TASK"] == "3"
+    assert lines["GREET"] == "hello tpu"
+    assert lines["SUMVEC"] == "8"
+    assert lines["CHAIN"] == "13"          # Plus(task_ref=3, 10)
+    assert lines["ACTOR"] == "3"           # 0 + 3
+    assert lines["ACTOR2"] == "6"          # 3 + task_ref(3)
+    assert lines["ACTORGET"] == "6"
+    assert "TYPED-APP-OK" in out.stdout
