@@ -52,6 +52,9 @@ class EngineConfig:
     # vLLM's num_scheduler_steps): amortizes host dispatch over K tokens at
     # the cost of up to K-1 wasted tokens past a stop condition.
     decode_steps: int = 8
+    # Static width of the per-token top-logprob report (requests may ask
+    # for fewer; more than this raises at add_request).
+    max_logprobs: int = 5
     # Full prompt pages are indexed by content hash and shared across
     # requests (the engine-side cache the prefix-aware router assumes).
     enable_prefix_cache: bool = True
@@ -78,6 +81,17 @@ class Request:
     temperature: float = 0.0
     stop_token: Optional[int] = None
     lora_id: str = ""  # adapter name ("" = base model)
+    # OpenAI sampling parity (reference:
+    # llm/_internal/serve/configs/openai_api_models.py:236): nucleus /
+    # top-k truncation run INSIDE the jitted sample step; `seed` pins this
+    # request's own PRNG chain (its stream depends only on its own
+    # sampling events, not on batch-mates).
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    seed: Optional[int] = None
+    # Number of top-alternative logprobs to return per token (0 = off;
+    # the chosen token's logprob is returned whenever > -1).
+    logprobs: int = 0
     # runtime state
     slot: int = -1
     generated: int = 0
@@ -89,6 +103,10 @@ class StepOutput:
     request_id: str
     token: int
     finished: bool
+    # log p(token) under the UNSCALED model distribution, plus the top-N
+    # (id, logprob) alternatives — populated when the request asked.
+    logprob: Optional[float] = None
+    top_logprobs: Optional[List[Tuple[int, float]]] = None
 
 
 class LLMEngine:
@@ -155,9 +173,21 @@ class LLMEngine:
         self.seq_lens = np.zeros((cfg.max_seqs,), np.int32)
         self.last_tokens = np.zeros((cfg.max_seqs,), np.int32)
         self.temps = np.zeros((cfg.max_seqs,), np.float32)
-        self._rng = jax.random.PRNGKey(0)
-        self._decode_fn = self._build_decode()
-        self._prefill_fns: Dict[int, Callable] = {}
+        self.top_ps = np.ones((cfg.max_seqs,), np.float32)
+        self.top_ks = np.zeros((cfg.max_seqs,), np.int32)
+        # Per-slot PRNG chains (seedable per request). Live on device and
+        # advance functionally inside the jitted steps — only for slots
+        # that actually sampled, so a request's stream is a pure function
+        # of its seed and its own token count.
+        self._keys_dev = jnp.asarray(
+            jax.random.split(jax.random.PRNGKey(0), cfg.max_seqs))
+        self._seed_counter = 0
+        # Jitted decode variants keyed by (rich_sampling, want_logprobs):
+        # the common greedy path pays for neither the top-p/top-k sort
+        # machinery nor the logprob softmax.
+        self._decode_fns: Dict[Tuple[bool, bool], Callable] = {}
+        self._prefill_fns: Dict[Tuple[int, int, bool, bool],
+                                Callable] = {}
         self._free_slots = list(range(cfg.max_seqs))
         self.prefix_cache = (PrefixCache(self.allocator)
                              if cfg.enable_prefix_cache else None)
@@ -241,13 +271,70 @@ class LLMEngine:
     # ------------------------------------------------------------------
     # Jitted steps
     # ------------------------------------------------------------------
-    def _build_decode(self):
+    def _sampler(self, rich: bool, want_lp: bool, L: int):
+        """Shared sample step for the prefill/decode variants.
+
+        Takes keys [n,2], logits [n,V], temps/top_ps [n], top_ks [n].
+        Returns (toks [n], new_keys [n,2], lp) where lp is None or
+        (chosen_logp [n], top_vals [n,L], top_ids [n,L]).
+
+        rich=True compiles nucleus + top-k truncation (a [n,V] sort per
+        step); rich=False is plain temperature/greedy. Both advance each
+        row's PRNG chain exactly once per call, so a seeded request's
+        stream is a pure function of its seed and its own token count."""
+
+        def sample(keys, logits, temps, top_ps, top_ks):
+            split = jax.vmap(lambda k: jax.random.split(k))(keys)
+            use, nxt = split[:, 0], split[:, 1]
+            scaled = logits / jnp.maximum(temps, 1e-3)[:, None]
+            if rich:
+                V = logits.shape[-1]
+                # top-k: drop strictly below the k-th largest (k=0 off)
+                desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+                kth = jnp.take_along_axis(
+                    desc, jnp.clip(top_ks - 1, 0, V - 1)[:, None],
+                    axis=-1)
+                scaled = jnp.where(
+                    (top_ks[:, None] > 0) & (scaled < kth),
+                    -jnp.inf, scaled)
+                # top-p over the surviving mass: keep a token iff the
+                # cumulative prob of STRICTLY higher-ranked tokens is
+                # still < p (the argmax token always survives)
+                desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(desc, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = (cum - probs) < top_ps[:, None]
+                cutoff = jnp.min(
+                    jnp.where(keep, desc, jnp.inf), axis=-1,
+                    keepdims=True)
+                scaled = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+            sampled = jax.vmap(jax.random.categorical)(use, scaled)
+            toks = jnp.where(temps > 0, sampled,
+                             jnp.argmax(logits, axis=-1)).astype(jnp.int32)
+            lp = None
+            if want_lp:
+                # OpenAI logprobs report the UNSCALED model distribution
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                chosen = jnp.take_along_axis(
+                    logp, toks[:, None], axis=-1)[:, 0]
+                top_vals, top_ids = jax.lax.top_k(logp, L)
+                lp = (chosen, top_vals, top_ids)
+            return toks, nxt, lp
+
+        return sample
+
+    def _decode_fn(self, rich: bool, want_lp: bool):
+        fn = self._decode_fns.get((rich, want_lp))
+        if fn is not None:
+            return fn
         model = self.model
         K = max(1, self.cfg.decode_steps)
+        L = max(1, self.cfg.max_logprobs)
         transform = self.param_transform
+        sample = self._sampler(rich, want_lp, L)
 
         def one(params, caches, last_tokens, page_table, seq_lens, active,
-                temps, rng, lora, lora_idx):
+                temps, top_ps, top_ks, keys, lora, lora_idx):
             if transform is not None:
                 params = transform(params)
             # positions of the NEW token = current length (before write).
@@ -258,47 +345,64 @@ class LLMEngine:
                 page_table=page_table, write_mask=active[:, None],
                 seq_lens=seq_lens + 1, lora=lora, lora_idx=lora_idx)
             logits = logits[:, 0].astype(jnp.float32)  # [B, V]
-            greedy = jnp.argmax(logits, axis=-1)
-            keys = jax.random.split(rng, logits.shape[0] + 1)
-            sampled = jax.vmap(
-                lambda k, l, t: jax.random.categorical(k, l / jnp.maximum(
-                    t, 1e-3)))(keys[1:], logits, temps)
-            toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-            return toks, new_caches, keys[0]
+            toks, nxt, lp = sample(keys, logits, temps, top_ps, top_ks)
+            # inactive slots keep their chain position
+            nxt = jnp.where(active[:, None], nxt, keys)
+            return toks, new_caches, nxt, lp
 
         def decode(params, caches, last_tokens, page_table, seq_lens,
-                   active, temps, rng, lora, lora_idx):
-            out = jnp.zeros((K, last_tokens.shape[0]), jnp.int32)
+                   active, temps, top_ps, top_ks, keys, lora, lora_idx):
+            B = last_tokens.shape[0]
+            out = jnp.zeros((K, B), jnp.int32)
+            out_lp = jnp.zeros((K, B), jnp.float32)
+            out_tv = jnp.zeros((K, B, L), jnp.float32)
+            out_ti = jnp.zeros((K, B, L), jnp.int32)
 
             def body(j, carry):
-                caches, toks, lens, rng, out = carry
-                toks, caches, rng = one(params, caches, toks, page_table,
-                                        lens, active, temps, rng, lora,
-                                        lora_idx)
-                return caches, toks, lens + 1, rng, out.at[j].set(toks)
+                (caches, toks, lens, keys, out, out_lp, out_tv,
+                 out_ti) = carry
+                toks, caches, keys, lp = one(
+                    params, caches, toks, page_table, lens, active,
+                    temps, top_ps, top_ks, keys, lora, lora_idx)
+                out = out.at[j].set(toks)
+                if lp is not None:
+                    out_lp = out_lp.at[j].set(lp[0])
+                    out_tv = out_tv.at[j].set(lp[1])
+                    out_ti = out_ti.at[j].set(lp[2])
+                return (caches, toks, lens + 1, keys, out, out_lp,
+                        out_tv, out_ti)
 
-            caches, last, lens, rng, out = jax.lax.fori_loop(
-                0, K, body, (caches, last_tokens, seq_lens, rng, out))
+            (caches, last, lens, keys, out, out_lp, out_tv, out_ti) = \
+                jax.lax.fori_loop(
+                    0, K, body,
+                    (caches, last_tokens, seq_lens, keys, out, out_lp,
+                     out_tv, out_ti))
             # Final last_tokens/seq_lens feed the NEXT window's dispatch
             # without a host round trip (pipeline_dispatch).
-            return out, last, lens, caches, rng
+            lp_out = (out_lp, out_tv, out_ti) if want_lp else None
+            return out, last, lens, caches, keys, lp_out
 
-        return jax.jit(decode, donate_argnums=(1,))
+        fn = jax.jit(decode, donate_argnums=(1,))
+        self._decode_fns[(rich, want_lp)] = fn
+        return fn
 
-    def _prefill_fn(self, bucket: int, nb: int = 1):
+    def _prefill_fn(self, bucket: int, nb: int = 1, rich: bool = False,
+                    want_lp: bool = False):
         """Batched prefill: `nb` sequences in ONE pass over the weights —
         a wave of admissions streams the (dequantized) parameters once
         instead of once per request, the dominant term in TTFT for
         HBM-bound models."""
-        fn = self._prefill_fns.get((bucket, nb))
+        fn = self._prefill_fns.get((bucket, nb, rich, want_lp))
         if fn is not None:
             return fn
         model = self.model
-
+        L = max(1, self.cfg.max_logprobs)
         transform = self.param_transform
+        sample = self._sampler(rich, want_lp, L)
 
         def prefill(params, caches, ids, rows, starts, true_lens,
-                    temps, rng, lora, lora_idx):
+                    temps, top_ps, top_ks, all_keys, slots, lora,
+                    lora_idx):
             if transform is not None:
                 params = transform(params)
             # ids [nb, bucket] = each prompt's SUFFIX from absolute
@@ -313,17 +417,21 @@ class LLMEngine:
                 lora=lora, lora_idx=lora_idx)
             last = logits[jnp.arange(nb), true_lens - 1].astype(
                 jnp.float32)  # [nb, V]
-            greedy = jnp.argmax(last, axis=-1)
-            keys = jax.random.split(rng, nb + 1)
-            sampled = jax.vmap(
-                lambda k, l, t: jax.random.categorical(
-                    k, l / jnp.maximum(t, 1e-3)))(keys[1:], last, temps)
-            toks = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-            return toks, new_caches, keys[0]
+            keys = all_keys[slots]
+            toks, nxt, lp = sample(keys, last, temps, top_ps, top_ks)
+            # write the advanced chains back into the [B,2] key table
+            all_keys = all_keys.at[slots].set(nxt)
+            return toks, new_caches, all_keys, lp
 
         fn = jax.jit(prefill, donate_argnums=(1,))
-        self._prefill_fns[(bucket, nb)] = fn
+        self._prefill_fns[(bucket, nb, rich, want_lp)] = fn
         return fn
+
+    def _sampling_flags(self, reqs) -> Tuple[bool, bool]:
+        rich = any(r.temperature > 0 and (r.top_p < 1.0 or r.top_k > 0)
+                   for r in reqs)
+        want_lp = any(r.logprobs > 0 for r in reqs)
+        return rich, want_lp
 
     def _dev(self, x):
         """Host → device, replicated across the mesh when TP is on (scalar
@@ -344,6 +452,14 @@ class LLMEngine:
             raise ValueError(
                 f"request needs up to {need} cache slots; max context is "
                 f"{self.cache_cfg.max_context}")
+        if not (0.0 < req.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {req.top_p}")
+        if req.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {req.top_k}")
+        if req.logprobs < 0 or req.logprobs > self.cfg.max_logprobs:
+            raise ValueError(
+                f"logprobs must be in [0, {self.cfg.max_logprobs}], got "
+                f"{req.logprobs}")
         if req.lora_id:
             if self.lora_banks is None:
                 raise KeyError(
@@ -422,32 +538,40 @@ class LLMEngine:
         active = np.zeros((self.cfg.max_seqs,), bool)
         for slot in self.running:
             active[slot] = True
-        toks, last, lens, self.caches, self._rng = self._decode_fn(
-            self.params, self.caches, self._dev(self.last_tokens),
-            self._dev(self.page_table), self._dev(self.seq_lens),
-            self._dev(active), self._dev(self.temps), self._rng,
-            self.lora_banks, self._dev(self.lora_idx))
-        return (toks, last, lens, frozenset(self.running))
+        rich, want_lp = self._sampling_flags(self.running.values())
+        toks, last, lens, self.caches, self._keys_dev, lp = \
+            self._decode_fn(rich, want_lp)(
+                self.params, self.caches, self._dev(self.last_tokens),
+                self._dev(self.page_table), self._dev(self.seq_lens),
+                self._dev(active), self._dev(self.temps),
+                self._dev(self.top_ps), self._dev(self.top_ks),
+                self._keys_dev, self.lora_banks, self._dev(self.lora_idx))
+        return (toks, last, lens, lp, frozenset(self.running))
 
     def _dispatch_window_from_device(self, window):
-        _, last, lens, slots = window
+        _, last, lens, _, slots = window
         active = np.zeros((self.cfg.max_seqs,), bool)
         for slot in self.running:
             active[slot] = True
-        toks, last, lens, self.caches, self._rng = self._decode_fn(
-            self.params, self.caches, last,
-            self._dev(self.page_table), lens,
-            self._dev(active), self._dev(self.temps), self._rng,
-            self.lora_banks, self._dev(self.lora_idx))
-        return (toks, last, lens, frozenset(self.running))
+        rich, want_lp = self._sampling_flags(self.running.values())
+        toks, last, lens, self.caches, self._keys_dev, lp = \
+            self._decode_fn(rich, want_lp)(
+                self.params, self.caches, last,
+                self._dev(self.page_table), lens,
+                self._dev(active), self._dev(self.temps),
+                self._dev(self.top_ps), self._dev(self.top_ks),
+                self._keys_dev, self.lora_banks, self._dev(self.lora_idx))
+        return (toks, last, lens, lp, frozenset(self.running))
 
     def _process_window(self, window,
                         out: Optional[List[StepOutput]]) -> bool:
         """Block on a window's tokens; update host mirrors and emit
         outputs. out=None discards (pipeline drain). Returns True if any
         slot finished."""
-        toks, _, _, slots = window
+        toks, _, _, lp, slots = window
         toks = np.asarray(toks)  # [K, B] (blocks here)
+        if lp is not None:
+            lp = tuple(np.asarray(a) for a in lp)
         if out is None:
             return False
         K = toks.shape[0]
@@ -455,6 +579,10 @@ class LLMEngine:
         for slot in slots:
             req = self.running.get(slot)
             if req is None:
+                continue
+            if req.done:  # aborted externally (e.g. stop-string match)
+                self._release(slot)
+                finished_any = True
                 continue
             for j in range(K):
                 tok = int(toks[j, slot])
@@ -464,7 +592,14 @@ class LLMEngine:
                 finished = (req.generated >= req.max_tokens
                             or (req.stop_token is not None
                                 and tok == req.stop_token))
-                out.append(StepOutput(req.request_id, tok, finished))
+                so = StepOutput(req.request_id, tok, finished)
+                if lp is not None and req.logprobs > 0:
+                    so.logprob = float(lp[0][j, slot])
+                    n = req.logprobs
+                    so.top_logprobs = [
+                        (int(lp[2][j, slot, i]), float(lp[1][j, slot, i]))
+                        for i in range(n)]
+                out.append(so)
                 if finished:
                     # Tokens past the stop within this window are wasted
                     # compute (multi-step tradeoff); drop them.
@@ -472,6 +607,21 @@ class LLMEngine:
                     finished_any = True
                     break
         return finished_any
+
+    def finish_request(self, request_id: str) -> bool:
+        """Finish a request early (serving layer stop-string match /
+        client disconnect). Safe from the engine-loop thread; the slot is
+        released at the next window boundary (an in-flight window's
+        remaining tokens for it are dropped)."""
+        for req in self.running.values():
+            if req.request_id == request_id:
+                req.done = True
+                return True
+        for req in list(self.waiting):
+            if req.request_id == request_id:
+                self.waiting.remove(req)
+                return True
+        return False
 
     def _admit(self, out: List[StepOutput]) -> bool:
         """Admit as many waiting requests as fit. The wave's prefills run
@@ -540,6 +690,17 @@ class LLMEngine:
             bucket = next((b for b in self.cfg.prefill_buckets if b >= S),
                           self.cache_cfg.max_context)
             self.temps[slot] = req.temperature
+            self.top_ps[slot] = req.top_p
+            self.top_ks[slot] = req.top_k
+            # Seed this slot's PRNG chain: explicit seed for reproducible
+            # requests, else a fresh engine-global counter.
+            if req.seed is not None:
+                seed = int(req.seed)
+            else:
+                self._seed_counter += 1
+                seed = (0x5eed << 20) + self._seed_counter
+            self._keys_dev = self._keys_dev.at[slot].set(
+                jax.random.PRNGKey(seed))
             self.lora_idx[slot] = self.lora_slot(req.lora_id) \
                 if self.lora_banks is not None else 0
             idx = len(entries)
@@ -577,6 +738,9 @@ class LLMEngine:
             starts = np.zeros((nb,), np.int32)
             lens = np.zeros((nb,), np.int32)
             temps = np.zeros((nb,), np.float32)
+            tps = np.ones((nb,), np.float32)
+            tks = np.zeros((nb,), np.int32)
+            slot_ids = np.zeros((nb,), np.int32)
             lidx = np.zeros((nb,), np.int32)
             for i, (slot, req, suffix, cached_len, S) in enumerate(wave):
                 ids[i, :S] = suffix
@@ -584,24 +748,37 @@ class LLMEngine:
                 starts[i] = cached_len
                 lens[i] = S
                 temps[i] = req.temperature
+                tps[i] = req.top_p
+                tks[i] = req.top_k
+                slot_ids[i] = slot
                 lidx[i] = self.lora_idx[slot]
-            dev_toks, self.caches, self._rng = self._prefill_fn(
-                bucket, nb)(
+            rich, want_lp = self._sampling_flags(
+                [entries[j][1] for j in batch])
+            dev_toks, self.caches, self._keys_dev, lp = self._prefill_fn(
+                bucket, nb, rich, want_lp)(
                 self.params, self.caches, self._dev(ids),
                 self._dev(rows), self._dev(starts), self._dev(lens),
-                self._dev(temps), self._rng, self.lora_banks,
+                self._dev(temps), self._dev(tps), self._dev(tks),
+                self._keys_dev, self._dev(slot_ids), self.lora_banks,
                 self._dev(lidx))
             for i, (slot, req, _, _, _) in enumerate(wave):
-                pending.append((slot, req, dev_toks, i))
+                pending.append((slot, req, dev_toks, lp, i))
             done.update(batch)
             remaining = [j for j in remaining if j not in done]
-        for slot, req, dev_toks, i in pending:
+        for slot, req, dev_toks, lp, i in pending:
             tok = int(np.asarray(dev_toks)[i])  # sync: all waves in flight
             self.last_tokens[slot] = tok
             finished = (req.generated >= req.max_tokens
                         or (req.stop_token is not None
                             and tok == req.stop_token))
-            out.append(StepOutput(req.request_id, tok, finished))
+            so = StepOutput(req.request_id, tok, finished)
+            if lp is not None and req.logprobs > 0:
+                so.logprob = float(np.asarray(lp[0])[i])
+                so.top_logprobs = [
+                    (int(np.asarray(lp[2])[i, k]),
+                     float(np.asarray(lp[1])[i, k]))
+                    for k in range(req.logprobs)]
+            out.append(so)
             if finished:
                 self._release(slot)
         return admitted
@@ -632,3 +809,5 @@ class LLMEngine:
         self._free_slots.append(slot)
         self.seq_lens[slot] = 0
         self.lora_idx[slot] = 0
+        self.top_ps[slot] = 1.0
+        self.top_ks[slot] = 0

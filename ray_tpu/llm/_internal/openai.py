@@ -56,6 +56,42 @@ class _IncrementalDecoder:
         return delta
 
 
+class _StopMatcher:
+    """Detokenized-window stop-string matching: emitted text trails the
+    decoded stream by (longest stop - 1) chars so a stop sequence that
+    spans token/chunk boundaries is caught before any of it is emitted
+    (reference: openai_api_models.py `stop`; vLLM's detokenized matcher)."""
+
+    def __init__(self, stops: List[str]):
+        self.stops = [s for s in stops if s]
+        self._hold = max((len(s) for s in self.stops), default=1) - 1
+        self._buf = ""
+
+    def push(self, delta: str) -> Any:
+        """Returns (text_to_emit, stopped)."""
+        self._buf += delta
+        best = -1
+        for s in self.stops:
+            i = self._buf.find(s)
+            if i >= 0 and (best < 0 or i < best):
+                best = i
+        if best >= 0:
+            emit, self._buf = self._buf[:best], ""
+            return emit, True
+        if self._hold and len(self._buf) > self._hold:
+            emit = self._buf[:-self._hold]
+            self._buf = self._buf[-self._hold:]
+            return emit, False
+        if not self._hold:
+            emit, self._buf = self._buf, ""
+            return emit, False
+        return "", False
+
+    def flush(self) -> str:
+        emit, self._buf = self._buf, ""
+        return emit
+
+
 class OpenAIServer:
     """Serve deployment: OpenAI-compatible endpoints over one engine."""
 
@@ -81,12 +117,14 @@ class OpenAIServer:
             if suffix.rstrip("/").endswith("/chat/completions"):
                 if stream:
                     return self._chat_stream(
-                        self._gen_kwargs(body), self._chat_ids(body))
+                        self._gen_kwargs(body), self._chat_ids(body),
+                        self._stops(body))
                 return self._chat(body)
             if suffix.rstrip("/").endswith("/completions"):
                 if stream:
                     return self._completions_stream(
-                        self._gen_kwargs(body), self._prompt_ids(body))
+                        self._gen_kwargs(body), self._prompt_ids(body),
+                        self._stops(body))
                 return self._completions(body)
         except ValueError as e:
             return _error(400, str(e))
@@ -118,7 +156,24 @@ class OpenAIServer:
             "max_tokens": int(body.get("max_tokens") or 64),
             "temperature": float(body.get("temperature") or 0.0),
             "stop_token": self.tokenizer.eot_id,
+            "top_p": float(body.get("top_p") if body.get("top_p")
+                           is not None else 1.0),
+            "top_k": int(body.get("top_k") or 0),
         }
+        if body.get("seed") is not None:
+            out["seed"] = int(body["seed"])
+        # completions: logprobs=<int>; chat: logprobs=true +
+        # top_logprobs=<int> (reference: openai_api_models.py:236)
+        lp = body.get("logprobs")
+        if isinstance(lp, bool):
+            out["logprobs"] = (int(body.get("top_logprobs") or 1)
+                               if lp else 0)
+        elif lp is not None:
+            out["logprobs"] = int(lp)
+        if not (0.0 < out["top_p"] <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {out['top_p']}")
+        if out["top_k"] < 0:
+            raise ValueError(f"top_k must be >= 0, got {out['top_k']}")
         # "model": "<base>:<adapter>" (or a bare adapter name) selects a
         # loaded LoRA — the reference's multiplexed model-id convention.
         model = str(body.get("model") or "")
@@ -128,52 +183,154 @@ class OpenAIServer:
                               if model.startswith(prefix) else model)
         return out
 
+    @staticmethod
+    def _stops(body: Dict[str, Any]) -> List[str]:
+        stop = body.get("stop")
+        if stop is None:
+            return []
+        if isinstance(stop, str):
+            return [stop]
+        return [str(s) for s in stop]
+
+    def _run(self, ids: List[int], body: Dict[str, Any]) -> Dict[str, Any]:
+        """Unary generation with stop-string halting: consume the stream,
+        decode incrementally, and CLOSE the generator the moment a stop
+        matches — the engine aborts the request (no wasted decode)."""
+        kwargs = self._gen_kwargs(body)
+        stops = self._stops(body)
+        dec = _IncrementalDecoder(self.tokenizer)
+        matcher = _StopMatcher(stops)
+        toks: List[int] = []
+        lps: List[float] = []
+        tops: List[Any] = []
+        text = ""
+        stopped = False
+        gen = self.server.generate(ids, **kwargs)
+        try:
+            for item in gen:
+                toks.append(item["token"])
+                if "logprob" in item:
+                    lps.append(item["logprob"])
+                    tops.append(item["top_logprobs"])
+                if stops:
+                    emit, stopped = matcher.push(dec.push(item["token"]))
+                    text += emit
+                    if stopped:
+                        break
+                else:
+                    text += dec.push(item["token"])
+        finally:
+            gen.close()
+        if stops and not stopped:
+            text += matcher.flush()
+        finish = "stop" if (stopped or _finish(toks, body,
+                                               self.tokenizer) == "stop") \
+            else "length"
+        out: Dict[str, Any] = {"tokens": toks, "text": text,
+                               "finish_reason": finish}
+        if lps:
+            out["logprobs"] = lps
+            out["top_logprobs"] = tops
+        return out
+
+    def _logprobs_block(self, res: Dict[str, Any], chat: bool
+                        ) -> Optional[Dict[str, Any]]:
+        if "logprobs" not in res:
+            return None
+        tok = self.tokenizer
+        if chat:
+            content = []
+            for t, lp, top in zip(res["tokens"], res["logprobs"],
+                                  res["top_logprobs"]):
+                content.append({
+                    "token": tok.decode([t]), "logprob": lp,
+                    "top_logprobs": [
+                        {"token": tok.decode([i]), "logprob": v}
+                        for i, v in top]})
+            return {"content": content}
+        return {
+            "tokens": [tok.decode([t]) for t in res["tokens"]],
+            "token_logprobs": res["logprobs"],
+            "top_logprobs": [
+                {tok.decode([i]): v for i, v in top}
+                for top in res["top_logprobs"]],
+        }
+
     # -- unary -----------------------------------------------------------
     def _completions(self, body: Dict[str, Any]) -> Dict[str, Any]:
         ids = self._prompt_ids(body)
-        out = self.server.generate_all(ids, **self._gen_kwargs(body))
-        text = self.tokenizer.decode(out["tokens"])
+        res = self._run(ids, body)
+        choice: Dict[str, Any] = {
+            "index": 0, "text": res["text"],
+            "finish_reason": res["finish_reason"]}
+        lp = self._logprobs_block(res, chat=False)
+        if lp is not None:
+            choice["logprobs"] = lp
         return {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "text_completion",
             "created": int(time.time()),
             "model": self.model_id,
-            "choices": [{"index": 0, "text": text,
-                         "finish_reason": _finish(out["tokens"], body,
-                                                  self.tokenizer)}],
-            "usage": _usage(ids, out["tokens"]),
+            "choices": [choice],
+            "usage": _usage(ids, res["tokens"]),
         }
 
     def _chat(self, body: Dict[str, Any]) -> Dict[str, Any]:
         ids = self._chat_ids(body)
-        out = self.server.generate_all(ids, **self._gen_kwargs(body))
-        text = self.tokenizer.decode(out["tokens"])
+        res = self._run(ids, body)
+        choice: Dict[str, Any] = {
+            "index": 0,
+            "message": {"role": "assistant", "content": res["text"]},
+            "finish_reason": res["finish_reason"]}
+        lp = self._logprobs_block(res, chat=True)
+        if lp is not None:
+            choice["logprobs"] = lp
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
             "created": int(time.time()),
             "model": self.model_id,
-            "choices": [{"index": 0,
-                         "message": {"role": "assistant", "content": text},
-                         "finish_reason": _finish(out["tokens"], body,
-                                                  self.tokenizer)}],
-            "usage": _usage(ids, out["tokens"]),
+            "choices": [choice],
+            "usage": _usage(ids, res["tokens"]),
         }
 
     # -- streaming (SSE) -------------------------------------------------
+    def _stream_deltas(self, gen_kwargs: Dict[str, Any],
+                       ids: List[int],
+                       stops: List[str]) -> Iterator[str]:
+        """Common SSE core: decoded text deltas with stop-string halting
+        (the generator is closed on a match, aborting the engine slot)."""
+        dec = _IncrementalDecoder(self.tokenizer)
+        matcher = _StopMatcher(stops)
+        gen = self.server.generate(ids, **gen_kwargs)
+        stopped = False
+        try:
+            for item in gen:
+                delta = dec.push(item["token"])
+                if stops:
+                    delta, stopped = matcher.push(delta)
+                if delta:
+                    yield delta
+                if stopped:
+                    return
+        finally:
+            gen.close()
+        if stops:
+            tail = matcher.flush()
+            if tail:
+                yield tail
+
     def _completions_stream(self, gen_kwargs: Dict[str, Any],
-                            ids: List[int]) -> Iterator[Any]:
+                            ids: List[int],
+                            stops: List[str]) -> Iterator[Any]:
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
         yield {"__http__": {"content_type": "text/event-stream"}}
-        dec = _IncrementalDecoder(self.tokenizer)
-        for item in self.server.generate(ids, **gen_kwargs):
-            delta = dec.push(item["token"])
-            if delta:
-                yield _sse({
-                    "id": rid, "object": "text_completion",
-                    "created": int(time.time()), "model": self.model_id,
-                    "choices": [{"index": 0, "text": delta,
-                                 "finish_reason": None}]})
+        for delta in self._stream_deltas(gen_kwargs, ids, stops):
+            yield _sse({
+                "id": rid, "object": "text_completion",
+                "created": int(time.time()), "model": self.model_id,
+                "choices": [{"index": 0, "text": delta,
+                             "finish_reason": None}]})
         yield _sse({
             "id": rid, "object": "text_completion",
             "created": int(time.time()), "model": self.model_id,
@@ -181,7 +338,8 @@ class OpenAIServer:
         yield "data: [DONE]\n\n"
 
     def _chat_stream(self, gen_kwargs: Dict[str, Any],
-                     ids: List[int]) -> Iterator[Any]:
+                     ids: List[int],
+                     stops: List[str]) -> Iterator[Any]:
         rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         yield {"__http__": {"content_type": "text/event-stream"}}
         yield _sse({
@@ -190,15 +348,12 @@ class OpenAIServer:
             "choices": [{"index": 0,
                          "delta": {"role": "assistant", "content": ""},
                          "finish_reason": None}]})
-        dec = _IncrementalDecoder(self.tokenizer)
-        for item in self.server.generate(ids, **gen_kwargs):
-            delta = dec.push(item["token"])
-            if delta:
-                yield _sse({
-                    "id": rid, "object": "chat.completion.chunk",
-                    "created": int(time.time()), "model": self.model_id,
-                    "choices": [{"index": 0, "delta": {"content": delta},
-                                 "finish_reason": None}]})
+        for delta in self._stream_deltas(gen_kwargs, ids, stops):
+            yield _sse({
+                "id": rid, "object": "chat.completion.chunk",
+                "created": int(time.time()), "model": self.model_id,
+                "choices": [{"index": 0, "delta": {"content": delta},
+                             "finish_reason": None}]})
         yield _sse({
             "id": rid, "object": "chat.completion.chunk",
             "created": int(time.time()), "model": self.model_id,

@@ -70,6 +70,7 @@ class LLMServer:
         self._queues: Dict[str, "queue.Queue"] = {}
         self._lock = threading.Lock()
         self._pending: "queue.Queue" = queue.Queue()
+        self._aborts: "queue.Queue" = queue.Queue()
         self._running = True
         threading.Thread(target=self._engine_loop, daemon=True,
                          name="llm-engine").start()
@@ -85,6 +86,12 @@ class LLMServer:
                     break
                 self.engine.add_request(req)
                 moved = True
+            while True:
+                try:
+                    rid = self._aborts.get_nowait()
+                except queue.Empty:
+                    break
+                self.engine.finish_request(rid)
             if not self.engine.has_work():
                 time.sleep(0.005 if moved else 0.01)
                 continue
@@ -101,16 +108,20 @@ class LLMServer:
                 with self._lock:
                     q = self._queues.get(so.request_id)
                 if q is not None:
-                    q.put(("token", so.token, so.finished))
+                    q.put(("token", so))
 
     # ------------------------------------------------------------------
     def generate(self, prompt_ids: List[int], max_tokens: int = 64,
                  temperature: float = 0.0,
                  stop_token: Optional[int] = None,
-                 lora_id: str = "") -> Iterator[Dict[str, Any]]:
+                 lora_id: str = "", top_p: float = 1.0, top_k: int = 0,
+                 seed: Optional[int] = None,
+                 logprobs: int = 0) -> Iterator[Dict[str, Any]]:
         """Streaming generation — one dict per token. lora_id selects a
         loaded adapter (reference: the model-id multiplex surface of
-        ray.llm's LoRA deployments)."""
+        ray.llm's LoRA deployments). Closing the generator early (stop
+        string matched, client gone) aborts the request in the engine so
+        its slot stops burning decode steps."""
         rid = uuid.uuid4().hex[:12]
         q: "queue.Queue" = queue.Queue()
         with self._lock:
@@ -120,37 +131,58 @@ class LLMServer:
                                   max_tokens=max_tokens,
                                   temperature=temperature,
                                   stop_token=stop_token,
-                                  lora_id=lora_id))
+                                  lora_id=lora_id, top_p=top_p,
+                                  top_k=top_k, seed=seed,
+                                  logprobs=logprobs))
         first = True
+        finished = False
         try:
             while True:
                 item = q.get(timeout=600)
                 if item[0] == "error":
                     raise RuntimeError(f"engine failed: {item[1]}")
-                _, tok, finished = item
-                out = {"token": int(tok)}
+                _, so = item
+                out = {"token": int(so.token)}
+                if so.logprob is not None:
+                    out["logprob"] = so.logprob
+                    out["top_logprobs"] = so.top_logprobs
                 if first:
                     out["ttft_s"] = time.perf_counter() - t0
                     first = False
+                finished = so.finished
                 yield out
                 if finished:
                     return
         finally:
+            if not finished:
+                self._aborts.put(rid)
             with self._lock:
                 self._queues.pop(rid, None)
 
     def generate_all(self, prompt_ids: List[int], max_tokens: int = 64,
                      temperature: float = 0.0,
                      stop_token: Optional[int] = None,
-                     lora_id: str = "") -> Dict[str, Any]:
+                     lora_id: str = "", top_p: float = 1.0,
+                     top_k: int = 0, seed: Optional[int] = None,
+                     logprobs: int = 0) -> Dict[str, Any]:
         """Unary variant: returns all tokens at once."""
         toks = []
+        lps: List[Any] = []
+        tops: List[Any] = []
         ttft = None
         for item in self.generate(prompt_ids, max_tokens, temperature,
-                                  stop_token, lora_id):
+                                  stop_token, lora_id, top_p, top_k,
+                                  seed, logprobs):
             toks.append(item["token"])
+            if "logprob" in item:
+                lps.append(item["logprob"])
+                tops.append(item["top_logprobs"])
             ttft = ttft if ttft is not None else item.get("ttft_s")
-        return {"tokens": toks, "ttft_s": ttft}
+        out = {"tokens": toks, "ttft_s": ttft}
+        if lps:
+            out["logprobs"] = lps
+            out["top_logprobs"] = tops
+        return out
 
     def load_lora(self, name: str, adapter: Dict[str, Any],
                   scale: float = 1.0) -> int:

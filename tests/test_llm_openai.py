@@ -149,3 +149,218 @@ def test_tp_engine_matches_single_device():
     out_tp = LLMServer(cfg).generate_all([5, 17, 42], max_tokens=6)
     out_1 = LLMServer(TINY).generate_all([5, 17, 42], max_tokens=6)
     assert out_tp["tokens"] == out_1["tokens"]
+
+
+# ---------------------------------------------------------------------------
+# Sampling parity: top_p/top_k in the jitted step, seeds, logprobs, stops
+# (reference: llm/_internal/serve/configs/openai_api_models.py:236)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models.llama import LlamaConfig, LlamaModel
+
+    cfg = LlamaConfig.tiny(vocab_size=128)
+    model = LlamaModel(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _fresh_engine(tiny_engine_parts, **over):
+    from ray_tpu.llm._internal.engine import EngineConfig, LLMEngine
+
+    model, params = tiny_engine_parts
+    kw = dict(max_seqs=2, page_size=4, max_pages_per_seq=16,
+              decode_steps=2)
+    kw.update(over)
+    return LLMEngine(model, params, EngineConfig(**kw))
+
+
+def _drain(eng):
+    got, steps = {}, 0
+    while eng.has_work() and steps < 500:
+        for so in eng.step():
+            got.setdefault(so.request_id, []).append(so)
+        steps += 1
+    return got
+
+
+def _greedy_oracle(tiny_engine_parts, prompt, n):
+    import jax.numpy as jnp
+
+    model, params = tiny_engine_parts
+    ids = list(prompt)
+    out = []
+    for _ in range(n):
+        logits = model.apply({"params": params},
+                             jnp.asarray([ids], jnp.int32))
+        tok = int(jnp.argmax(logits[0, -1]))
+        out.append(tok)
+        ids.append(tok)
+    return out
+
+
+def test_top_p_mass_truncation(tiny_engine_parts):
+    """top_p -> 0 keeps only the head of the distribution: with a
+    vanishingly small nucleus, sampling at ANY temperature must collapse
+    to greedy (the argmax token always survives truncation)."""
+    from ray_tpu.llm._internal.engine import Request
+
+    prompt = [5, 17, 42, 7]
+    oracle = _greedy_oracle(tiny_engine_parts, prompt, 8)
+    for kwargs in ({"top_p": 1e-6}, {"top_k": 1}):
+        eng = _fresh_engine(tiny_engine_parts)
+        eng.add_request(Request("r", prompt, max_tokens=8,
+                                temperature=1.0, seed=123, **kwargs))
+        got = [so.token for so in _drain(eng)["r"]]
+        assert got == oracle, (kwargs, got, oracle)
+
+
+def test_top_p_between_extremes_stays_in_nucleus(tiny_engine_parts):
+    """With 0 < top_p < 1 every sampled token must come from the smallest
+    prefix of the sorted distribution whose mass reaches top_p."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm._internal.engine import Request
+
+    model, params = tiny_engine_parts
+    prompt = [5, 17, 42, 7]
+    top_p = 0.6
+    eng = _fresh_engine(tiny_engine_parts)
+    eng.add_request(Request("r", prompt, max_tokens=10, temperature=1.0,
+                            top_p=top_p, seed=7))
+    toks = [so.token for so in _drain(eng)["r"]]
+    # replay: at each step check membership in the nucleus
+    ids = list(prompt)
+    for t in toks:
+        logits = np.asarray(model.apply(
+            {"params": params}, jnp.asarray([ids], jnp.int32))[0, -1],
+            np.float64)
+        probs = np.exp(logits - logits.max())
+        probs /= probs.sum()
+        order = np.argsort(-probs)
+        cum = np.cumsum(probs[order])
+        nucleus = set(order[:int(np.searchsorted(cum, top_p) + 1)])
+        assert t in nucleus, (t, sorted(nucleus))
+        ids.append(t)
+
+
+def test_seed_reproducibility(tiny_engine_parts):
+    from ray_tpu.llm._internal.engine import Request
+
+    prompt = [9, 3, 11]
+    runs = []
+    for seed in (42, 42, 43):
+        eng = _fresh_engine(tiny_engine_parts)
+        eng.add_request(Request("r", prompt, max_tokens=12,
+                                temperature=5.0, seed=seed))
+        runs.append([so.token for so in _drain(eng)["r"]])
+    assert runs[0] == runs[1], "same seed must reproduce the stream"
+    assert runs[0] != runs[2], "different seeds should diverge (temp=5)"
+
+
+def test_logprobs_match_model_distribution(tiny_engine_parts):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm._internal.engine import Request
+
+    model, params = tiny_engine_parts
+    prompt = [5, 17, 42, 7]
+    eng = _fresh_engine(tiny_engine_parts)
+    eng.add_request(Request("r", prompt, max_tokens=6, logprobs=3))
+    outs = _drain(eng)["r"]
+    ids = list(prompt)
+    for so in outs:
+        logits = np.asarray(model.apply(
+            {"params": params}, jnp.asarray([ids], jnp.int32))[0, -1],
+            np.float64)
+        logp = logits - logits.max()
+        logp -= np.log(np.exp(logp).sum())
+        assert so.logprob == pytest.approx(logp[so.token], abs=1e-3)
+        tops = so.top_logprobs
+        assert len(tops) == 3
+        # sorted descending and headed by the greedy token
+        vals = [v for _, v in tops]
+        assert vals == sorted(vals, reverse=True)
+        assert tops[0][0] == so.token  # greedy: chosen == top-1
+        ids.append(so.token)
+
+
+def test_openai_stop_strings_token_exact(serve_instance):
+    """Stop strings halt the completion token-exactly: the response text
+    is the full greedy text truncated at the first stop occurrence, and
+    the engine stops decoding past it (no trailing stop text)."""
+    from ray_tpu.llm import build_openai_app
+
+    app = build_openai_app(TINY)
+    serve.run(app, route_prefix="/v1")
+    port = serve.http_port()
+
+    base = {"model": "tiny-test-model", "prompt": "hello",
+            "max_tokens": 24, "temperature": 0.0}
+    status, _, data = _http(port, "POST", "/v1/completions", dict(base))
+    assert status == 200, data
+    full = json.loads(data)["choices"][0]["text"]
+    assert len(full) >= 4, f"tiny model emitted too little text: {full!r}"
+    stop = full[2:4]
+    idx = full.find(stop)
+
+    status, _, data = _http(port, "POST", "/v1/completions",
+                            {**base, "stop": stop})
+    assert status == 200, data
+    out = json.loads(data)
+    assert out["choices"][0]["text"] == full[:idx]
+    assert out["choices"][0]["finish_reason"] == "stop"
+    # the engine actually halted early (stop cut tokens, not just text)
+    assert out["usage"]["completion_tokens"] < 24
+
+    # streaming path: identical truncation through SSE deltas
+    status, ctype, data = _http(
+        port, "POST", "/v1/completions",
+        {**base, "stop": [stop], "stream": True})
+    assert status == 200
+    frames = [ln for ln in data.decode().split("\n\n") if ln.strip()]
+    chunks = [json.loads(f[len("data: "):]) for f in frames[:-1]]
+    text = "".join(c["choices"][0]["text"] for c in chunks
+                   if c["choices"][0]["finish_reason"] is None)
+    assert text == full[:idx]
+
+
+def test_openai_logprobs_and_sampling_params_http(serve_instance):
+    from ray_tpu.llm import build_openai_app
+
+    app = build_openai_app(TINY)
+    serve.run(app, route_prefix="/v1")
+    port = serve.http_port()
+
+    status, _, data = _http(
+        port, "POST", "/v1/completions",
+        {"model": "tiny-test-model", "prompt": "hi", "max_tokens": 4,
+         "temperature": 0.7, "top_p": 0.9, "top_k": 20, "seed": 5,
+         "logprobs": 2})
+    assert status == 200, data
+    out = json.loads(data)
+    lp = out["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == len(lp["token_logprobs"])
+    assert all(len(t) == 2 for t in lp["top_logprobs"])
+
+    # chat variant: logprobs=true + top_logprobs
+    status, _, data = _http(
+        port, "POST", "/v1/chat/completions",
+        {"messages": [{"role": "user", "content": "hi"}], "max_tokens": 3,
+         "logprobs": True, "top_logprobs": 2})
+    assert status == 200, data
+    content = json.loads(data)["choices"][0]["logprobs"]["content"]
+    assert len(content) == 3
+    assert all(len(c["top_logprobs"]) == 2 for c in content)
+
+    # validation: bad top_p is a 400, not a 500
+    status, _, data = _http(
+        port, "POST", "/v1/completions",
+        {"prompt": "x", "top_p": 1.5})
+    assert status == 400
