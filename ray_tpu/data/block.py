@@ -1,11 +1,16 @@
 """Columnar blocks — the unit of data movement (reference: python/ray/data/
-block.py `Block`/`BlockMetadata`, _internal/arrow_block.py).
+block.py `Block`/`BlockMetadata`, _internal/arrow_block.py:194
+ArrowBlockAccessor).
 
-TPU-first redesign: a block is a dict of numpy arrays (column name → column).
-Numpy-native blocks feed `jax.device_put` with zero conversion — the reference
-uses Arrow because its consumers are pandas/torch; ours are jitted programs
-whose host-side staging format IS numpy. Rows (dicts) and scalar items are
-wrapped into the single "value" column.
+TPU-first redesign: the DEVICE STAGING block is a dict of numpy arrays
+(column name → column) — numpy-native blocks feed `jax.device_put` with
+zero conversion, because our consumers are jitted programs. A second
+native block kind, `pyarrow.Table`, carries typed schemas (strings,
+nulls, nested lists) through IO and shuffles: parquet/csv readers produce
+Arrow directly, slicing/concat stay zero-copy Arrow ops, and
+`as_numpy_block` converts at the compute boundary — numeric null-free
+columns become ZERO-COPY numpy views over the Arrow buffers. Every
+helper below accepts either kind.
 """
 
 from __future__ import annotations
@@ -15,9 +20,74 @@ from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
-Block = Dict[str, np.ndarray]
+# dict-of-numpy (device staging) or pyarrow.Table (typed schema carrier)
+Block = Any
 
 VALUE_COL = "value"
+
+
+def is_arrow_block(block: Any) -> bool:
+    try:
+        import pyarrow as pa
+    except ImportError:  # pragma: no cover - pyarrow is baked in
+        return False
+    return isinstance(block, pa.Table)
+
+
+def as_arrow_block(block: Block) -> Any:
+    """Any block → pyarrow.Table (multi-dim numpy columns become lists)."""
+    import pyarrow as pa
+
+    if is_arrow_block(block):
+        return block
+    return pa.table({k: (list(v) if getattr(v, "ndim", 1) > 1 else v)
+                     for k, v in block.items()})
+
+
+def as_numpy_block(block: Block) -> Dict[str, np.ndarray]:
+    """Any block → dict-of-numpy. For Arrow input, numeric columns
+    without nulls become zero-copy views over the Arrow buffers
+    (read-only, like the reference's ArrowBlockAccessor.to_numpy);
+    strings/nulls/nested lists fall back to object/materialized arrays."""
+    if not is_arrow_block(block):
+        return block
+    out: Dict[str, np.ndarray] = {}
+    for name in block.column_names:
+        col = block.column(name)
+        chunked = col.combine_chunks() if col.num_chunks != 1 \
+            else col.chunk(0)
+        try:
+            out[name] = chunked.to_numpy(zero_copy_only=True)
+        except Exception:  # nulls / non-primitive: copy semantics
+            try:
+                out[name] = chunked.to_numpy(zero_copy_only=False)
+            except Exception:
+                vals = chunked.to_pylist()
+                arr = np.empty(len(vals), dtype=object)
+                arr[:] = vals
+                out[name] = arr
+    return out
+
+
+def as_pandas_batch(block: Block):
+    import pandas as pd
+
+    if is_arrow_block(block):
+        return block.to_pandas()
+    return pd.DataFrame({k: (list(v) if getattr(v, "ndim", 1) > 1 else v)
+                         for k, v in block.items()})
+
+
+def block_as_format(block: Block, batch_format: Optional[str]) -> Any:
+    """Boundary conversion for user-facing batches (reference:
+    batch_format= on map_batches/iter_batches)."""
+    if batch_format in (None, "default", "numpy"):
+        return as_numpy_block(block)
+    if batch_format == "pyarrow":
+        return as_arrow_block(block)
+    if batch_format == "pandas":
+        return as_pandas_batch(block)
+    raise ValueError(f"unknown batch_format {batch_format!r}")
 
 
 @dataclasses.dataclass
@@ -28,6 +98,11 @@ class BlockMetadata:
 
     @staticmethod
     def of(block: Block) -> "BlockMetadata":
+        if is_arrow_block(block):
+            return BlockMetadata(
+                num_rows=block.num_rows, size_bytes=block.nbytes,
+                schema={f.name: (str(f.type), ())
+                        for f in block.schema})
         return BlockMetadata(
             num_rows=block_num_rows(block),
             size_bytes=sum(v.nbytes for v in block.values()),
@@ -36,6 +111,8 @@ class BlockMetadata:
 
 
 def block_num_rows(block: Block) -> int:
+    if is_arrow_block(block):
+        return block.num_rows
     if not block:
         return 0
     return len(next(iter(block.values())))
@@ -70,6 +147,10 @@ def _column_array(values: List[Any], force_object: bool = False
 
 
 def block_to_items(block: Block) -> List[Any]:
+    if is_arrow_block(block):
+        if block.column_names == [VALUE_COL]:
+            return block.column(VALUE_COL).to_pylist()
+        return block.to_pylist()
     n = block_num_rows(block)
     if set(block.keys()) == {VALUE_COL}:
         return list(block[VALUE_COL])
@@ -77,6 +158,8 @@ def block_to_items(block: Block) -> List[Any]:
 
 
 def block_slice(block: Block, start: int, end: int) -> Block:
+    if is_arrow_block(block):
+        return block.slice(start, end - start)  # zero-copy
     return {k: v[start:end] for k, v in block.items()}
 
 
@@ -84,11 +167,20 @@ def block_concat(blocks: Sequence[Block]) -> Block:
     blocks = [b for b in blocks if block_num_rows(b)]
     if not blocks:
         return {}
+    if all(is_arrow_block(b) for b in blocks):
+        import pyarrow as pa
+
+        return pa.concat_tables(blocks, promote_options="default")
+    blocks = [as_numpy_block(b) for b in blocks]
     keys = blocks[0].keys()
     return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
 
 
 def block_select(block: Block, mask: np.ndarray) -> Block:
+    if is_arrow_block(block):
+        import pyarrow as pa
+
+        return block.filter(pa.array(mask))
     return {k: v[mask] for k, v in block.items()}
 
 
@@ -105,11 +197,23 @@ def iter_block_batches(block: Block, batch_size: Optional[int]) -> Iterator[Bloc
 def normalize_batch_output(out: Any) -> Block:
     """User map_batches output → block. Accepts dict-of-arrays, list of rows,
     or a numpy array (becomes the "value" column)."""
+    if is_arrow_block(out):
+        return out
     if isinstance(out, dict):
         return {k: np.asarray(v) for k, v in out.items()}
     if isinstance(out, np.ndarray):
         return {VALUE_COL: out}
     if isinstance(out, (list, tuple)):
         return block_from_items(out)
+    try:
+        import pandas as pd
+
+        if isinstance(out, pd.DataFrame):
+            import pyarrow as pa
+
+            return pa.Table.from_pandas(out, preserve_index=False)
+    except ImportError:  # pragma: no cover
+        pass
     raise TypeError(
-        f"map_batches fn must return dict/ndarray/list, got {type(out)}")
+        f"map_batches fn must return dict/ndarray/list/Table/DataFrame, "
+        f"got {type(out)}")

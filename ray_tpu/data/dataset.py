@@ -48,6 +48,11 @@ from ray_tpu.data.block import (
     block_to_items,
     iter_block_batches,
     normalize_batch_output,
+    as_arrow_block,
+    as_numpy_block,
+    as_pandas_batch,
+    block_as_format,
+    is_arrow_block,
 )
 
 DEFAULT_BLOCK_ROWS = 4096
@@ -86,6 +91,7 @@ class _MapBatches:
     window: int = DEFAULT_WINDOW
     name: str = "MapBatches"
     fn_kwargs: Optional[Dict[str, Any]] = None
+    batch_format: Optional[str] = None  # None = numpy staging format
     # Set by _fuse_plan: a chain of map ops executed inside ONE task.
     fused_stages: Optional[List["_MapBatches"]] = None
 
@@ -108,14 +114,17 @@ class _MapBatchesActor:
     fn_constructor_args: tuple = ()
     fn_constructor_kwargs: Optional[Dict[str, Any]] = None
     fn_kwargs: Optional[Dict[str, Any]] = None
+    batch_format: Optional[str] = None
 
 
 def _apply_map_batches(op: _MapBatches, block: Block) -> Block:
     for stage in op.fused_stages or [op]:
         outs = []
         kwargs = stage.fn_kwargs or {}
+        fmt = getattr(stage, "batch_format", None)
         for batch in iter_block_batches(block, stage.batch_size):
-            outs.append(normalize_batch_output(stage.fn(batch, **kwargs)))
+            outs.append(normalize_batch_output(
+                stage.fn(block_as_format(batch, fmt), **kwargs)))
         block = block_concat(outs) if outs else {}
     return block
 
@@ -191,6 +200,7 @@ def _actor_map_stream(op: _MapBatchesActor,
     from collections import deque
 
     cls, batch_size, fn_kwargs = op.cls, op.batch_size, op.fn_kwargs or {}
+    fmt = op.batch_format
     ctor_args = op.fn_constructor_args
     ctor_kwargs = op.fn_constructor_kwargs or {}
 
@@ -203,7 +213,7 @@ def _actor_map_stream(op: _MapBatchesActor,
             outs = []
             for batch in iter_block_batches(block, batch_size):
                 outs.append(normalize_batch_output(
-                    self.inst(batch, **fn_kwargs)))
+                    self.inst(block_as_format(batch, fmt), **fn_kwargs)))
             return block_concat(outs) if outs else {}
 
     actor_cls = _BatchWorker.options(
@@ -250,22 +260,26 @@ class Dataset:
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     num_cpus: float = 1.0, num_tpus: float = 0.0,
                     concurrency: int = DEFAULT_WINDOW,
+                    batch_format: Optional[str] = None,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[Dict[str, Any]] = None,
                     fn_kwargs: Optional[Dict[str, Any]] = None) -> "Dataset":
         """Function transforms run as tasks; a callable CLASS runs on a pool
         of `concurrency` stateful actors, constructed once each (reference:
-        TaskPoolMapOperator vs ActorPoolMapOperator)."""
+        TaskPoolMapOperator vs ActorPoolMapOperator). batch_format selects
+        what `fn` sees: "numpy" (default; zero-copy views for Arrow-backed
+        numeric columns), "pyarrow", or "pandas"."""
         if isinstance(fn, type):
             return Dataset(self._plan + [_MapBatchesActor(
                 fn, batch_size, concurrency=concurrency, num_cpus=num_cpus,
                 num_tpus=num_tpus, name=f"MapBatches({fn.__name__})",
                 fn_constructor_args=fn_constructor_args,
                 fn_constructor_kwargs=fn_constructor_kwargs,
-                fn_kwargs=fn_kwargs)])
+                fn_kwargs=fn_kwargs, batch_format=batch_format)])
         return Dataset(self._plan + [_MapBatches(
             fn, batch_size, num_cpus, concurrency,
-            name=getattr(fn, "__name__", "map_batches"), fn_kwargs=fn_kwargs)])
+            name=getattr(fn, "__name__", "map_batches"),
+            fn_kwargs=fn_kwargs, batch_format=batch_format)])
 
     def map(self, fn: Callable, **opts) -> "Dataset":
         def _map_rows(batch: Block) -> Block:
@@ -319,8 +333,19 @@ class Dataset:
 
     def iter_batches(self, *, batch_size: Optional[int] = 256,
                      prefetch_batches: int = 1,
-                     drop_last: bool = False) -> Iterator[Block]:
-        """Re-batched streaming iteration (reference: data/iterator.py)."""
+                     drop_last: bool = False,
+                     batch_format: Optional[str] = "numpy"
+                     ) -> Iterator[Block]:
+        """Re-batched streaming iteration (reference: data/iterator.py).
+        Arrow-backed blocks slice zero-copy; with the default
+        batch_format="numpy", numeric null-free columns are yielded as
+        zero-copy numpy views over the Arrow buffers."""
+        for b in self._iter_batches_raw(batch_size=batch_size,
+                                        drop_last=drop_last):
+            yield block_as_format(b, batch_format)
+
+    def _iter_batches_raw(self, *, batch_size: Optional[int],
+                          drop_last: bool) -> Iterator[Block]:
         leftover: Optional[Block] = None
         for block in self.iter_blocks():
             if leftover is not None and block_num_rows(leftover):
@@ -476,6 +501,7 @@ class Dataset:
 
                 @ray_tpu.remote
                 def _local_shuffle(b: Block, seed=seed) -> Block:
+                    b = as_numpy_block(b)
                     n = block_num_rows(b)
                     perm = np.random.default_rng(seed).permutation(n)
                     return {k: np.asarray(v)[perm] for k, v in b.items()}
@@ -484,6 +510,7 @@ class Dataset:
 
             @ray_tpu.remote
             def _scatter(block: Block, block_seed: int, P=P):
+                block = as_numpy_block(block)
                 rng = np.random.default_rng(block_seed)
                 codes = rng.integers(0, P, block_num_rows(block))
                 return tuple(
@@ -494,7 +521,8 @@ class Dataset:
             @ray_tpu.remote
             def _merge_permute(part_seed: int, *parts: Block) -> Block:
                 nonempty = [p for p in parts if block_num_rows(p)]
-                merged = block_concat(nonempty) if nonempty else {}
+                merged = as_numpy_block(
+                    block_concat(nonempty) if nonempty else {})
                 n = block_num_rows(merged)
                 perm = np.random.default_rng(part_seed).permutation(n)
                 return {k: np.asarray(v)[perm] for k, v in merged.items()}
@@ -524,6 +552,7 @@ class Dataset:
             @ray_tpu.remote
             def _sort_block(b: Block, key=key,
                             descending=descending) -> Block:
+                b = as_numpy_block(b)
                 order = np.argsort(np.asarray(b[key]), kind="stable")
                 if descending:
                     order = order[::-1]
@@ -534,6 +563,7 @@ class Dataset:
 
             @ray_tpu.remote
             def _sample(b: Block, key=key, k: int = 64):
+                b = as_numpy_block(b)
                 vals = np.sort(np.asarray(b[key]))
                 if len(vals) == 0:
                     return vals
@@ -553,6 +583,7 @@ class Dataset:
 
             @ray_tpu.remote
             def _range_part(block: Block, key=key, bounds=bounds, P=P):
+                block = as_numpy_block(block)
                 codes = np.searchsorted(bounds, np.asarray(block[key]),
                                         side="right")
                 return tuple(
@@ -564,7 +595,8 @@ class Dataset:
             def _sort_merge(key: str, descending: bool,
                             *parts: Block) -> Block:
                 nonempty = [p for p in parts if block_num_rows(p)]
-                merged = block_concat(nonempty) if nonempty else {}
+                merged = as_numpy_block(
+                    block_concat(nonempty) if nonempty else {})
                 if not block_num_rows(merged):
                     return merged
                 order = np.argsort(np.asarray(merged[key]), kind="stable")
@@ -621,6 +653,7 @@ class Dataset:
 
             @ray_tpu.remote
             def _partition(block: Block, key=key, P=P):
+                block = as_numpy_block(block)
                 if not block or not block_num_rows(block):
                     # empty upstream block (e.g. a filter that dropped
                     # everything): every partition gets its empty schema
@@ -660,6 +693,7 @@ class Dataset:
             @ray_tpu.remote
             def _schema(b: Block):
                 import numpy as np
+                b = as_numpy_block(b)
                 return [(c, str(np.asarray(v).dtype)) for c, v in b.items()]
 
             # Schema hints (column name + dtype — no payload): an empty
@@ -691,6 +725,7 @@ class Dataset:
                 import pandas as pd
 
                 def frame(b, sch):
+                    b = as_numpy_block(b)
                     if b:
                         return pd.DataFrame(dict(b))
                     return pd.DataFrame(
@@ -734,10 +769,12 @@ class Dataset:
 
             @ray_tpu.remote
             def _zip_part(lb: Block, ranges, *rblocks) -> Block:
+                lb = as_numpy_block(lb)
                 parts = [block_slice(rb, lo, hi)
                          for rb, (lo, hi) in zip(rblocks, ranges)]
                 nonempty = [p for p in parts if block_num_rows(p)]
-                rb = block_concat(nonempty) if nonempty else {}
+                rb = as_numpy_block(
+                    block_concat(nonempty) if nonempty else {})
                 out = dict(lb)
                 for k, v in rb.items():
                     out[k if k not in out else f"{k}_1"] = v
@@ -828,7 +865,7 @@ class Dataset:
         blocks = list(self.iter_blocks())
         if not blocks:
             return pd.DataFrame()
-        return pd.concat([pd.DataFrame(dict(b)) for b in blocks],
+        return pd.concat([as_pandas_batch(b) for b in blocks],
                          ignore_index=True)
 
     def stats(self) -> str:
@@ -842,17 +879,19 @@ class Dataset:
 def _write_parquet_part(block: Block, idx: int, path: str) -> None:
     import os
 
-    import pyarrow as pa
     import pyarrow.parquet as pq
 
-    table = pa.table({k: list(v) if v.ndim > 1 else v
-                      for k, v in block.items()})
+    # Arrow blocks (e.g. straight from read_parquet/read_csv) write
+    # directly — typed schemas (strings, nulls, nested lists) round-trip.
+    table = as_arrow_block(block)
     pq.write_table(table, os.path.join(path, f"part-{idx:05d}.parquet"))
 
 
 def _write_json_part(block: Block, idx: int, path: str) -> None:
     import json
     import os
+
+    block = as_numpy_block(block)
 
     with open(os.path.join(path, f"part-{idx:05d}.jsonl"), "w") as f:
         for row in block_to_items(block):
@@ -867,6 +906,8 @@ def _write_json_part(block: Block, idx: int, path: str) -> None:
 def _write_csv_part(block: Block, idx: int, path: str) -> None:
     import csv
     import os
+
+    block = as_numpy_block(block)
 
     cols = list(block.keys())
     with open(os.path.join(path, f"part-{idx:05d}.csv"), "w",
@@ -915,6 +956,7 @@ class GroupedData:
             def agg_block(block, key=key, fn=fn, cols=cols, suffix=suffix):
                 if not block_num_rows(block):
                     return {}
+                block = as_numpy_block(block)
                 keys = np.asarray(block[key])
                 uniq, inv = np.unique(keys, return_inverse=True)
                 use = [c for c in (cols or block.keys()) if c != key]
@@ -945,6 +987,7 @@ class GroupedData:
             def count_block(block, key=key):
                 if not block_num_rows(block):
                     return {}
+                block = as_numpy_block(block)
                 keys = np.asarray(block[key])
                 uniq, inv = np.unique(keys, return_inverse=True)
                 return {key: uniq,
@@ -1049,21 +1092,27 @@ def read_parquet(path: str) -> Dataset:
         import pyarrow.parquet as pq
 
         for p in paths:
-            table = pq.read_table(p)
-            yield {name: np.asarray(table[name])
-                   for name in table.column_names}
+            # Arrow-native block: typed schema (strings, nulls, nested
+            # lists) survives; numeric columns convert zero-copy at the
+            # compute boundary (reference: _internal/arrow_block.py:194).
+            yield pq.read_table(p)
 
     return Dataset([_Source(gen, name="ReadParquet")])
 
 
 def read_csv(path: str) -> Dataset:
-    def gen():
-        import csv
+    """One Arrow block per csv file — columns come back TYPED (ints/floats
+    inferred), not as strings (reference: read_api.py read_csv via
+    pyarrow.csv)."""
+    paths = _expand_paths(path, ".csv")
 
-        with open(path) as f:
-            rows = list(csv.DictReader(f))
-        if rows:
-            yield block_from_items(rows)
+    def gen():
+        from pyarrow import csv as pa_csv
+
+        for p in paths:
+            table = pa_csv.read_csv(p)
+            if table.num_rows:
+                yield table
 
     return Dataset([_Source(gen, name="ReadCSV")])
 
