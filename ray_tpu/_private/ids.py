@@ -17,7 +17,7 @@ _HEX = "0123456789abcdef"
 
 class BaseID:
     SIZE = 16
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash")
 
     def __init__(self, binary: bytes):
         if len(binary) != self.SIZE:
@@ -25,6 +25,7 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {len(binary)}"
             )
         self._bytes = binary
+        self._hash = 0  # lazily computed; IDs key hot dicts (ref counts)
 
     @classmethod
     def from_random(cls):
@@ -48,7 +49,11 @@ class BaseID:
         return self._bytes.hex()
 
     def __hash__(self):
-        return hash((type(self).__name__, self._bytes))
+        h = self._hash
+        if h == 0:
+            h = hash((type(self).__name__, self._bytes)) or 1
+            self._hash = h
+        return h
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

@@ -14,6 +14,22 @@ from ray_tpu._private.ids import ObjectID
 # (host, port) of the owning worker's RPC server; None = owned locally.
 Address = Optional[Tuple[str, int]]
 
+_worker_mod = None
+
+
+def _worker_or_none():
+    """Module-cached worker lookup: ObjectRef __init__/__del__ are the
+    hottest paths in ref-heavy gets (100k+ calls/s); a function-level
+    `from ... import` costs a sys.modules probe per call."""
+    global _worker_mod
+    if _worker_mod is None:
+        try:
+            from ray_tpu._private import worker as worker_mod
+        except ImportError:
+            return None
+        _worker_mod = worker_mod
+    return _worker_mod.global_worker_or_none()
+
 
 class ObjectRef:
     __slots__ = ("id", "owner_address", "_borrowed", "_registered")
@@ -27,11 +43,7 @@ class ObjectRef:
             self._register_borrow()
 
     def _register_borrow(self) -> None:
-        try:
-            from ray_tpu._private import worker as worker_mod
-        except ImportError:
-            return
-        w = worker_mod.global_worker_or_none()
+        w = _worker_or_none()
         if w is not None:
             w.ref_counter.add_borrowed_ref(self)
             self._registered = True
@@ -64,9 +76,7 @@ class ObjectRef:
 
     def __del__(self):
         try:
-            from ray_tpu._private import worker as worker_mod
-
-            w = worker_mod.global_worker_or_none()
+            w = _worker_or_none()
             if w is not None:
                 w.ref_counter.remove_local_ref(self.id)
         except Exception:

@@ -51,14 +51,26 @@ class ReferenceCounter:
             rec.local += 1
 
     def add_borrowed_ref(self, ref) -> None:
+        self.add_borrowed_refs((ref,))
+
+    def add_borrowed_refs(self, refs) -> None:
+        """Bulk borrow registration: one lock acquisition for a whole
+        deserialized value (a get of 10k refs would otherwise pay
+        lock+report bookkeeping 10k times)."""
         with self._lock:
-            rec = self._records.setdefault(ref.id, _Record(owned=False))
-            rec.local += 1
-            if ref.owner_address is not None:
-                addr = tuple(ref.owner_address)
-                rec.owner_address = addr
-                self._pending_borrow_reports.setdefault(addr, []).append(
-                    ("add", ref.id))
+            records = self._records
+            reports = self._pending_borrow_reports
+            for ref in refs:
+                rec = records.get(ref.id)
+                if rec is None:
+                    rec = records[ref.id] = _Record(owned=False)
+                rec.local += 1
+                if ref.owner_address is not None:
+                    addr = tuple(ref.owner_address)
+                    rec.owner_address = addr
+                    reports.setdefault(addr, []).append(("add", ref.id))
+        for ref in refs:
+            ref._registered = True
 
     def add_borrower(self, object_id: ObjectID, borrower: Tuple[str, int]) -> None:
         """Owner side: a remote worker now holds a reference."""

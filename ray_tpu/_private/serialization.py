@@ -99,6 +99,11 @@ class _Pickler(cloudpickle.Pickler):
 class _Unpickler(pickle.Unpickler):
     def __init__(self, file, buffers):
         super().__init__(file, buffers=buffers)
+        # Refs created during load, borrow-registered in ONE bulk call
+        # after load completes: per-ref registration costs a lock
+        # acquisition + borrow-report append each, which dominates gets
+        # of ref-heavy values (e.g. a list of 10k refs).
+        self.loaded_refs: List[Any] = []
 
     def persistent_load(self, pid):
         tag, payload = pid
@@ -106,7 +111,8 @@ class _Unpickler(pickle.Unpickler):
             from ray_tpu._private.object_ref import ObjectRef
 
             binary, owner_address = payload
-            ref = ObjectRef(ObjectID(binary), owner_address=owner_address, _borrowed=True)
+            ref = ObjectRef(ObjectID(binary), owner_address=owner_address)
+            self.loaded_refs.append(ref)
             return ref
         raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
 
@@ -148,7 +154,15 @@ def deserialize(obj: SerializedObject) -> Any:
         exc = pickle.loads(obj.buffers[0])
         raise exc
     file = io.BytesIO(obj.buffers[0])
-    return _Unpickler(file, buffers=obj.buffers[1:]).load()
+    unpickler = _Unpickler(file, buffers=obj.buffers[1:])
+    value = unpickler.load()
+    if unpickler.loaded_refs:
+        from ray_tpu._private.object_ref import _worker_or_none
+
+        w = _worker_or_none()
+        if w is not None:
+            w.ref_counter.add_borrowed_refs(unpickler.loaded_refs)
+    return value
 
 
 def deserialize_or_error(obj: SerializedObject) -> Any:

@@ -627,6 +627,12 @@ class Nodelet:
                     "worker_id": worker.worker_id.binary(),
                     "worker_address": worker.address,
                     "node_id": self.node_id.binary(),
+                    # Other lease requests are parked on this node RIGHT
+                    # NOW: the grantee's pump must not linger-hold the
+                    # worker when its queue idles (a 0.2 s idle hold per
+                    # rotation starves contending submitters ~5x on a
+                    # worker-starved node).
+                    "contended": bool(self._lease_waiters),
                 }
             if not block:
                 if pg_bundle is None:

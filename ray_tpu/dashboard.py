@@ -78,7 +78,15 @@ class DashboardActor:
         if path == "/healthz":
             return 200, b'"ok"'
         if path == "/" or path == "/index.html":
-            return 200, _INDEX_HTML, "text/html"
+            return 200, _load_ui(), "text/html"
+        if path.rstrip("/") == "/api/timeline":
+            # chrome://tracing-format download (reference: `ray timeline`)
+            try:
+                events = await loop.run_in_executor(None, state.timeline)
+                return 200, json.dumps(events).encode()
+            except Exception as e:
+                logger.exception("timeline export failed")
+                return 500, json.dumps({"error": str(e)}).encode()
         if path.rstrip("/") == "/metrics":
             # Prometheus text exposition (reference: the per-node metrics
             # agent + prometheus_exporter.py; single scrape endpoint here).
@@ -114,6 +122,22 @@ class DashboardActor:
         except Exception as e:
             logger.exception("dashboard route %s failed", path)
             return 500, json.dumps({"error": str(e)}).encode()
+
+
+def _load_ui() -> bytes:
+    """The single-page UI (dashboard_ui.html next to this module):
+    stat tiles + live tables over /api/*, charts sampled client-side from
+    /metrics, timeline download. Falls back to the embedded minimal page
+    if the asset is missing (e.g. partial install)."""
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "dashboard_ui.html")
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return _INDEX_HTML
 
 
 _INDEX_HTML = b"""<!doctype html>
@@ -164,8 +188,11 @@ def start_dashboard(port: int = 0) -> int:
     except Exception:
         pass
     Actor = ray_tpu.remote(_NamedDashboard)
+    # Detached: the dashboard must outlive the (possibly short-lived CLI)
+    # driver that started it — `ray_tpu start --head` spawns it and exits.
     actor = Actor.options(name=DASHBOARD_ACTOR_NAME, max_concurrency=16,
-                          num_cpus=0.5, get_if_exists=True).remote(port)
+                          num_cpus=0.5, get_if_exists=True,
+                          lifetime="detached").remote(port)
     return ray_tpu.get(actor.start.remote(), timeout=60)
 
 

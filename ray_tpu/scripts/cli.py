@@ -76,17 +76,37 @@ def cmd_start(args) -> None:
                     node_name=args.node_name)
         role = "worker"
     pids = [p.pid for p in node.processes]
+    dashboard_url = ""
+    if args.head and not args.no_dashboard:
+        # Live-state web UI (reference: `ray start --head` prints
+        # "View the dashboard at http://...").
+        try:
+            import ray_tpu
+            from ray_tpu.dashboard import start_dashboard
+
+            ray_tpu.init(address=f"{node.gcs_address[0]}:"
+                                 f"{node.gcs_address[1]}")
+            port = start_dashboard()
+            dashboard_url = f"http://127.0.0.1:{port}"
+            ray_tpu.shutdown()
+        except Exception as e:  # noqa: BLE001
+            print(f"dashboard failed to start: {e!r}", file=sys.stderr)
     _write_cluster_file({
         "head": args.head, "gcs_address": list(node.gcs_address),
         "session_dir": node.session_dir, "pids": pids,
         "started_at": time.time(),
+        "dashboard_url": dashboard_url,
     })
     print(json.dumps({
         "role": role,
         "gcs_address": f"{node.gcs_address[0]}:{node.gcs_address[1]}",
         "session_dir": node.session_dir,
         "pids": pids,
+        **({"dashboard_url": dashboard_url} if dashboard_url else {}),
     }, indent=2))
+    if dashboard_url:
+        print(f"View the dashboard at {dashboard_url}",
+              file=sys.stderr, flush=True)
     if args.block:
         print("-- blocking; Ctrl-C or `stop` to shut down --",
               file=sys.stderr, flush=True)
@@ -230,6 +250,7 @@ def main() -> None:
 
     p = sub.add_parser("start", help="start a head or worker node")
     p.add_argument("--head", action="store_true")
+    p.add_argument("--no-dashboard", action="store_true")
     p.add_argument("--address", help="GCS host:port to join (worker mode)")
     p.add_argument("--resources", help="JSON resource dict override")
     p.add_argument("--object-store-memory", type=int, default=0)

@@ -19,9 +19,15 @@ VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
 class PlacementGroup:
     def __init__(self, pg_id: PlacementGroupID,
-                 bundles: List[Dict[str, float]]):
+                 bundles: List[Dict[str, float]],
+                 create_fut=None):
         self.id = pg_id
         self.bundle_specs = bundles
+        # In-flight create RPC (reference: pg creation is asynchronous —
+        # python/ray/util/placement_group.py:146 returns a handle at once
+        # and ready() is what waits). Pipelining N creates removes N
+        # serial GCS round-trips from create/remove churn.
+        self._create_fut = create_fut
 
     def ready(self, timeout: Optional[float] = 30.0) -> bool:
         """Block until the group is scheduled (reference returns an ObjectRef;
@@ -29,6 +35,9 @@ class PlacementGroup:
         so this only waits on retries after node churn)."""
         w = worker_mod.global_worker()
         deadline = None if timeout is None else time.monotonic() + timeout
+        if self._create_fut is not None:
+            self._create_fut.result(timeout)
+            self._create_fut = None
         while True:
             info = w.loop_thread.run(
                 w.gcs_client.call("get_placement_group",
@@ -60,7 +69,11 @@ def placement_group(
         raise ValueError("bundles must be non-empty resource dicts")
     w = worker_mod.global_worker()
     pg_id = PlacementGroupID.from_random()
-    reply = w.loop_thread.run(
+    # Creation is asynchronous, like the reference: the RPC is in flight
+    # when this returns; ready() (or any PG-targeted lease, which the GCS
+    # serializes after creation) syncs with it. Infeasibility surfaces via
+    # ready() as the GCS retries while nodes join.
+    fut = w.loop_thread.run_async(
         w.gcs_client.call(
             "create_placement_group",
             pg_id=pg_id.binary(),
@@ -68,15 +81,16 @@ def placement_group(
             strategy=strategy,
             name=name,
         ))
-    pg = PlacementGroup(pg_id, bundles)
-    if not reply.get("ok"):
-        # Match the reference: creation returns immediately; infeasibility
-        # surfaces via ready() (the GCS retries as nodes join).
-        pass
-    return pg
+    return PlacementGroup(pg_id, bundles, create_fut=fut)
 
 
 def remove_placement_group(pg: PlacementGroup) -> None:
     w = worker_mod.global_worker()
+    if pg._create_fut is not None:
+        # Never let a remove race ahead of its own create on the wire.
+        try:
+            pg._create_fut.result(30)
+        finally:
+            pg._create_fut = None
     w.loop_thread.run(
         w.gcs_client.call("remove_placement_group", pg_id=pg.id.binary()))

@@ -158,14 +158,23 @@ def cluster_summary() -> Dict[str, Any]:
             total[k] = total.get(k, 0) + v
         for k, v in n["resources_available"].items():
             avail[k] = avail.get(k, 0) + v
+    actors = summarize_actors()
+    jobs = list_jobs()
+    workers = list_workers()
     return {
         "nodes_alive": sum(1 for n in nodes if n["alive"]),
         "nodes_dead": sum(1 for n in nodes if not n["alive"]),
         "resources_total": total,
         "resources_available": avail,
-        "actors": summarize_actors(),
+        "actors": actors,
+        "actors_alive": actors.get("ALIVE", 0),
+        "workers": len(workers),
         "placement_groups": len(list_placement_groups()),
-        "jobs": len(list_jobs()),
+        "jobs": len(jobs),
+        "jobs_running": sum(1 for j in jobs
+                            if j.get("status") in ("RUNNING", "PENDING")),
+        "tasks_running": sum(1 for w in workers if w.get("leased")),
+        "cpu_available": avail.get("CPU", 0.0),
     }
 
 
