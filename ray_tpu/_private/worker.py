@@ -636,6 +636,10 @@ class Worker:
         self._generators: Dict[TaskID, Any] = {}
         # In-flight lineage recoveries: object_id -> future.
         self._recoveries: Dict[ObjectID, "asyncio.Future"] = {}
+        # Partial chunked pulls this process can peer-serve:
+        # object binary id -> (flat buffer, set of landed chunk offsets).
+        self._active_pulls: Dict[bytes, Tuple[bytearray, set]] = {}
+        self._peer_chunk_clients: Dict[Tuple[str, int], RpcClient] = {}
         # Actor-state cache fed by GCS pubsub (replaces per-submitter
         # polling). Keyed by actor_id hex; _actor_pulse fires on any update.
         self._actor_states: Dict[str, Dict[str, Any]] = {}
@@ -764,6 +768,7 @@ class Worker:
         s.register("push_actor_task", self._rpc_push_actor_task)
         s.register("push_actor_task_batch", self._rpc_push_actor_task_batch)
         s.register("get_object", self._rpc_get_object)
+        s.register("peer_fetch_chunk", self._rpc_peer_fetch_chunk)
         s.register("wait_object", self._rpc_wait_object)
         s.register("update_borrows", self._rpc_update_borrows)
         s.register("check_borrows", self._rpc_check_borrows)
@@ -1154,6 +1159,23 @@ class Worker:
         if target is None:
             raise ObjectLostError(f"node for object {object_id} is gone")
         cfg = get_config()
+        # Same-host fast path: another nodelet's arena on THIS machine is
+        # directly mappable — one memcpy out of tmpfs beats N chunk RPCs
+        # (serialize + 2 socket crossings + reassembly per chunk). This is
+        # the same-host half of the reference's Push/PullManager locality
+        # (push_manager.h:27); genuinely-remote pulls take the chunk path
+        # below, with peer chunk serving spreading the source load.
+        if (cfg.object_transfer_same_host_arena
+                and target.get("object_store_path")
+                and tuple(target["address"])[0] == self.address[0]):
+            obj = self._fetch_same_host_arena(
+                object_id, target["object_store_path"])
+            if obj is not None:
+                try:
+                    self.shm.put_serialized(object_id, obj)
+                except Exception:
+                    pass
+                return obj
         t = None if deadline is None else deadline - time.monotonic()
         client = RpcClient(*target["address"], name="fetch")
         try:
@@ -1183,6 +1205,77 @@ class Worker:
             pass
         return obj
 
+    async def _peer_chunk_client(self, addr: Tuple[str, int]) -> RpcClient:
+        client = self._peer_chunk_clients.get(addr)
+        if client is None:
+            client = RpcClient(*addr, name="peer-chunk")
+            self._peer_chunk_clients[addr] = client
+        return client
+
+    async def _rpc_peer_fetch_chunk(self, object_id: bytes, offset: int,
+                                    length: int) -> Dict[str, Any]:
+        """Serve one chunk of an object this worker holds (fully in shm,
+        or partially mid-pull) to another puller the owner redirected
+        here. {"missing": True} sends the peer back to the owner."""
+        import pickle
+
+        active = self._active_pulls.get(object_id)
+        if active is not None:
+            flat, done = active
+            if offset in done:
+                return {"data": pickle.PickleBuffer(
+                    memoryview(flat)[offset:offset + length])}
+        obj = self.shm.get_serialized(ObjectID(object_id))
+        if obj is None:
+            return {"missing": True}
+        spans = []
+        pos = 0
+        for buf in obj.buffers:
+            n = len(buf)
+            if pos + n <= offset:
+                pos += n
+                continue
+            start = max(0, offset - pos)
+            take = min(n - start, offset + length - (pos + start))
+            if take > 0:
+                spans.append(memoryview(buf)[start:start + take])
+            pos += n
+            if sum(len(s) for s in spans) >= length:
+                break
+        if not spans:
+            return {"missing": True}
+        if len(spans) == 1:
+            return {"data": pickle.PickleBuffer(spans[0])}
+        out = bytearray()
+        for s in spans:
+            out += s
+        return {"data": pickle.PickleBuffer(out)}
+
+    def _fetch_same_host_arena(self, object_id: ObjectID, store_path: str):
+        """Read an object straight out of a same-host peer nodelet's shm
+        arena (returns None -> caller falls back to the RPC pull). The
+        returned buffers are pinned zero-copy views of the peer arena;
+        the pin releases when the last consumer drops (and survives peer
+        death: the mapping outlives an unlink)."""
+        import os
+
+        from ray_tpu.core.object_store import SharedMemoryStore
+
+        if not os.path.exists(store_path):
+            return None  # different machine/namespace after all
+        cache = self.__dict__.setdefault("_peer_arenas", {})
+        store = cache.get(store_path)
+        if store is None:
+            try:
+                store = SharedMemoryStore(store_path, prefault=False)
+            except OSError:
+                return None
+            cache[store_path] = store
+        try:
+            return store.get_serialized(object_id)
+        except Exception:  # torn mapping (peer died mid-open): RPC path
+            return None
+
     @property
     def _pull_sem(self) -> "asyncio.Semaphore":
         # Shared across every concurrent fetch in this process: the
@@ -1203,21 +1296,65 @@ class Worker:
         total = sum(info["sizes"])
         flat = bytearray(total)
         self._last_fetch_chunks = -(-total // chunk)  # test introspection
+        # Peer chunk serving (reference: PushManager/PullManager chunk
+        # machinery, push_manager.h:27): landed chunks are (a) reported to
+        # the owner piggybacked on the next chunk request, so the owner
+        # learns locations from pull acks, and (b) servable to other
+        # pullers the owner redirects here — a broadcast becomes a chunk
+        # distribution tree instead of N serial full pulls from one node.
+        done: set = set()
+        unreported: List[int] = []
+        self._active_pulls[object_id.binary()] = (flat, done)
+        self._fetch_redirects = getattr(self, "_fetch_redirects", 0)
+
+        async def pull_from_peer(addr, off: int, length: int) -> bool:
+            try:
+                peer = await self._peer_chunk_client(tuple(addr))
+                t = (None if deadline is None
+                     else deadline - time.monotonic())
+                reply = await peer.call(
+                    "peer_fetch_chunk", object_id=object_id.binary(),
+                    offset=off, length=length, timeout=t)
+            except Exception:  # noqa: BLE001 - peer gone: owner fallback
+                return False
+            if not isinstance(reply, dict) or "data" not in reply:
+                return False
+            with memoryview(reply["data"]) as mv:
+                if mv.nbytes != length:
+                    return False
+                flat[off:off + mv.nbytes] = mv
+            self._fetch_redirects += 1
+            return True
 
         async def pull_one(off: int) -> None:
             length = min(chunk, total - off)
             async with self._pull_sem:
                 t = (None if deadline is None
                      else deadline - time.monotonic())
+                have, unreported[:] = unreported[:], []
                 reply = await client.call(
                     "fetch_object_chunk", object_id=object_id.binary(),
-                    offset=off, length=length, timeout=t)
+                    offset=off, length=length, timeout=t,
+                    puller=list(self.address), have=have)
+                if isinstance(reply, dict) and "redirect" in reply:
+                    if not await pull_from_peer(
+                            reply["redirect"], off, length):
+                        reply = await client.call(
+                            "fetch_object_chunk",
+                            object_id=object_id.binary(), offset=off,
+                            length=length, timeout=t, no_redirect=True)
+                    else:
+                        done.add(off)
+                        unreported.append(off)
+                        return
             if reply is None:
                 raise ObjectLostError(
                     f"object {object_id} vanished mid-transfer")
             data = reply["data"] if isinstance(reply, dict) else reply
             with memoryview(data) as mv:
                 flat[off:off + mv.nbytes] = mv
+            done.add(off)
+            unreported.append(off)
 
         tasks = [asyncio.ensure_future(pull_one(off))
                  for off in range(0, total, chunk)]
@@ -1230,7 +1367,11 @@ class Worker:
             for t in tasks:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
+            self._active_pulls.pop(object_id.binary(), None)
             raise
+        # Completed: peers now find the object in local shm (the caller
+        # puts it there); drop the partial-pull registration.
+        self._active_pulls.pop(object_id.binary(), None)
         # Zero-copy re-slice of the assembled bytes into the original
         # buffer boundaries (the views keep `flat` alive).
         buffers: List[Any] = []
@@ -1829,21 +1970,48 @@ class Worker:
             runtime_env=_prepare_runtime_env(runtime_env,
                                               self._gcs_call_sync),
         )
-        reply = self.loop_thread.run(
-            self.gcs_client.call_retrying(
-                "register_actor",
-                actor_id=actor_id.binary(),
-                creation_spec=ser_spec(spec),
-                name=name,
-                max_restarts=max_restarts,
-                detached=detached,
-                get_if_exists=get_if_exists,
-            )
+        register = self.gcs_client.call_retrying(
+            "register_actor",
+            actor_id=actor_id.binary(),
+            creation_spec=ser_spec(spec),
+            name=name,
+            max_restarts=max_restarts,
+            detached=detached,
+            get_if_exists=get_if_exists,
         )
-        if not reply.get("ok"):
-            raise ValueError(reply.get("error", "actor registration failed"))
-        if reply.get("existing_actor_id"):
-            return ActorID(reply["existing_actor_id"])
+        if name or get_if_exists:
+            # The reply decides which actor the handle refers to: block.
+            reply = self.loop_thread.run(register)
+            if not reply.get("ok"):
+                raise ValueError(
+                    reply.get("error", "actor registration failed"))
+            if reply.get("existing_actor_id"):
+                return ActorID(reply["existing_actor_id"])
+            return actor_id
+        # Anonymous actors: creation is ASYNCHRONOUS, like the reference's
+        # actor-creation task — the handle returns immediately and N
+        # creations pipeline through the GCS instead of paying N serial
+        # round-trips (the dominant term in actor churn). A registration
+        # failure poisons the local state cache so pending calls raise
+        # instead of waiting on an actor that never existed.
+
+        async def _register():
+            try:
+                reply = await register
+            except Exception as e:  # noqa: BLE001
+                reply = {"ok": False, "error": repr(e)}
+            if not reply.get("ok"):
+                logger.warning("async actor registration failed: %s",
+                               reply.get("error"))
+                self._actor_states[actor_id.hex()] = {
+                    "state": "DEAD",
+                    "error": reply.get("error",
+                                       "actor registration failed"),
+                }
+                self._actor_pulse.set()
+                self._actor_pulse.clear()
+
+        asyncio.run_coroutine_threadsafe(_register(), self.loop)
         return actor_id
 
     def submit_actor_task(

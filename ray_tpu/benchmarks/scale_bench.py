@@ -74,7 +74,8 @@ def many_pgs_bench(ray_tpu, *, total: int = 200) -> Dict[str, Any]:
 
 
 def broadcast_bench(ray_tpu, cluster, *, n_nodes: int = 4,
-                    size_mb: int = 1024) -> Dict[str, Any]:
+                    size_mb: int = 1024,
+                    prefix: str = "bcast") -> Dict[str, Any]:
     """1 GiB object broadcast to `n_nodes` worker nodelets (reference:
     object_store.json — 12.6 s to 50 nodes). Each consumer is an actor
     pinned to its own nodelet via node resources; the get pulls the object
@@ -82,7 +83,7 @@ def broadcast_bench(ray_tpu, cluster, *, n_nodes: int = 4,
     import numpy as np
 
     for i in range(n_nodes):
-        cluster.add_node(num_cpus=1, resources={f"bcast{i}": 1.0},
+        cluster.add_node(num_cpus=1, resources={f"{prefix}{i}": 1.0},
                          object_store_memory=int(size_mb * 1.5) * 2**20)
 
     @ray_tpu.remote
@@ -90,7 +91,7 @@ def broadcast_bench(ray_tpu, cluster, *, n_nodes: int = 4,
         def pull(self, ref):
             return int(ref[-1])  # materialized on THIS node
 
-    pullers = [Puller.options(resources={f"bcast{i}": 0.5}).remote()
+    pullers = [Puller.options(resources={f"{prefix}{i}": 0.5}).remote()
                for i in range(n_nodes)]
     arr = np.ones(size_mb * 2**20, np.uint8)
     ref = ray_tpu.put(arr)
@@ -125,4 +126,9 @@ def run_scale_suite(ray_tpu, cluster=None,
         out["broadcast"] = broadcast_bench(ray_tpu, cluster)
         if progress:
             progress(f"broadcast: {out['broadcast']}")
+        # Wider fan-out: 8 more nodelets (distinct from the 4 above).
+        out["broadcast_8"] = broadcast_bench(
+            ray_tpu, cluster, n_nodes=8, size_mb=1024, prefix="bcast8_")
+        if progress:
+            progress(f"broadcast_8: {out['broadcast_8']}")
     return out

@@ -248,6 +248,9 @@ class GcsServer:
         self.pubsub = PubsubChannels()
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
+        # kill_actor arrivals for ids not registered yet (client-side
+        # async actor creation): the late registration lands dead.
+        self._prekilled: set = set()
         self.named_actors: Dict[str, ActorID] = {}
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.kv: Dict[str, bytes] = {}
@@ -597,6 +600,12 @@ class GcsServer:
         info = ActorInfo(aid, creation_spec, name, max_restarts, detached)
         self.actors[aid] = info
         self.mark_dirty()
+        if aid in self._prekilled:
+            # A kill raced ahead of this (asynchronous) registration:
+            # land the actor dead instead of scheduling a zombie.
+            self._prekilled.discard(aid)
+            await self._actor_dead(info, "killed before registration")
+            return {"ok": True}
         asyncio.ensure_future(self._schedule_actor(info))
         return {"ok": True}
 
@@ -747,8 +756,18 @@ class GcsServer:
                              no_restart: bool = True) -> Dict[str, Any]:
         info = self.actors.get(ActorID(actor_id))
         if info is None:
+            # Actor registration is asynchronous on the client: a kill can
+            # legitimately arrive BEFORE register_actor. Tombstone the id
+            # so the late registration lands dead instead of leaking a
+            # zombie nobody holds a handle to.
+            self._prekilled.add(ActorID(actor_id))
             return {"ok": False, "error": "no such actor"}
-        await self._kill_actor(info, "ray_tpu.kill", no_restart=no_restart)
+        # Reply as soon as the kill is ACCEPTED (reference: ray.kill is
+        # asynchronous); the FSM transition + worker exit proceed on this
+        # loop. A churn wave killing N actors then pays N cheap acks, not
+        # N full teardowns.
+        asyncio.ensure_future(
+            self._kill_actor(info, "ray_tpu.kill", no_restart=no_restart))
         return {"ok": True}
 
     async def _kill_actor(self, info: ActorInfo, cause: str,

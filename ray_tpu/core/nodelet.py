@@ -543,6 +543,7 @@ class Nodelet:
             if (not w.leased and w.env_key == env_key and w.ready.is_set()
                     and w.proc.poll() is None):
                 w.leased = True
+                self._maybe_prewarm(env_key)
                 return w
         env_updates: Dict[str, str] = {}
         if runtime_env and (runtime_env.get("working_dir")
@@ -560,6 +561,7 @@ class Nodelet:
             None, lambda: self._spawn_worker(
                 env_key, runtime_env, needs_tpu, tpu_chips, env_updates))
         handle.leased = True
+        self._maybe_prewarm(env_key)
         try:
             await asyncio.wait_for(handle.ready.wait(),
                                    get_config().worker_start_timeout_s)
@@ -570,6 +572,41 @@ class Nodelet:
             self.workers.pop(handle.worker_id, None)
             raise
         return handle
+
+    def _maybe_prewarm(self, env_key: str) -> None:
+        """Keep a small reserve of BOOTED plain-CPU workers ahead of
+        demand (reference: the WorkerPool's prestarted python workers).
+        Forking + boot (~10-20 ms each) then happens in the background
+        between lease waves instead of on the bring-up critical path —
+        actor/worker churn overlaps its spawn cost with driver-side work."""
+        cfg = get_config()
+        if env_key != "" or cfg.worker_prewarm <= 0:
+            return  # only the vanilla pool is predictably reusable
+        if self.__dict__.get("_prewarming"):
+            return
+        idle = sum(1 for w in self.workers.values()
+                   if not w.leased and w.env_key == ""
+                   and w.proc.poll() is None)
+        want = min(cfg.worker_prewarm - idle,
+                   max(0, cfg.worker_pool_max - len(self.workers)))
+        if want <= 0:
+            return
+        self.__dict__["_prewarming"] = True
+
+        async def _replenish(n: int) -> None:
+            loop = asyncio.get_running_loop()
+            try:
+                for _ in range(n):
+                    try:
+                        await loop.run_in_executor(
+                            None, lambda: self._spawn_worker(
+                                "", None, False, None, {}))
+                    except Exception:
+                        return  # zygote down / spawn failing: stop quietly
+            finally:
+                self.__dict__["_prewarming"] = False
+
+        asyncio.ensure_future(_replenish(want))
 
     # ------------------------------------------------------------------
     # Leases (reference: RequestWorkerLease node_manager.proto:394 +
@@ -839,16 +876,75 @@ class Nodelet:
             }
         return {"metadata": bytes(obj.metadata), "sizes": sizes}
 
+    # Peer-serving directory: object id -> chunk offset -> puller worker
+    # addresses known (from pull acks) to hold that chunk. Bounded; a
+    # stale entry just costs the redirected puller one fallback RPC.
+    _CHUNK_DIR_MAX_OBJECTS = 16
+
+    def _learn_chunk_locations(self, object_id: bytes, puller, have) -> None:
+        if not puller or not have:
+            return
+        directory = self.__dict__.setdefault("_chunk_dir", {})
+        if object_id not in directory \
+                and len(directory) >= self._CHUNK_DIR_MAX_OBJECTS:
+            directory.pop(next(iter(directory)))
+        entry = directory.setdefault(object_id, {})
+        addr = tuple(puller)
+        for off in have:
+            holders = entry.setdefault(int(off), [])
+            if addr not in holders:
+                holders.append(addr)
+
+    def _chunk_redirect(self, object_id: bytes, offset: int,
+                        puller) -> Optional[List[Any]]:
+        """When another puller already holds this chunk, alternate between
+        serving bytes and handing out the peer's address — the owner
+        becomes a distribution-tree ROOT serving ~half the load while
+        peers fan out the rest (reference: push_manager.h:27 /
+        pull_manager.h:49). The 50/50 split self-balances on a node that
+        is the sole source: redirecting everything would idle the owner's
+        own bandwidth."""
+        if not puller:
+            return None
+        entry = self.__dict__.get("_chunk_dir", {}).get(object_id)
+        if not entry:
+            return None
+        holders = [a for a in entry.get(int(offset), ())
+                   if a != tuple(puller)]
+        if not holders:
+            return None
+        rr = self.__dict__.get("_redir_rr", 0) + 1
+        self.__dict__["_redir_rr"] = rr
+        if rr % 2 == 0:
+            return None  # owner serves this one directly
+        return list(holders[rr % len(holders)])
+
     async def rpc_fetch_object_chunk(
-            self, object_id: bytes, offset: int,
-            length: int) -> Optional[Dict[str, Any]]:
+            self, object_id: bytes, offset: int, length: int,
+            puller: Optional[List[Any]] = None,
+            have: Optional[List[int]] = None,
+            no_redirect: bool = False) -> Optional[Dict[str, Any]]:
         """Chunked-pull step 2: one slice of the logical concatenation of
         the object's buffers (reference: ObjectManager chunked Push/Pull,
         object_buffer_pool.h). The slice ships as a pickle-5 out-of-band
         buffer: when it falls inside one source buffer (the common case —
         one numpy payload) it is a zero-copy view of the shm arena all the
         way to the socket (the view holds the arena read pin); spans are
-        assembled once into a bytearray, still oob on the wire."""
+        assembled once into a bytearray, still oob on the wire.
+
+        `puller`+`have` piggyback the caller's landed chunks (pull acks);
+        under concurrent pressure the reply may be {"redirect": addr}
+        pointing at a peer that holds the chunk (no_redirect forces
+        bytes — the fallback after a failed peer fetch)."""
+        self._learn_chunk_locations(object_id, puller, have)
+        if not no_redirect:
+            redirect = self._chunk_redirect(object_id, offset, puller)
+            if redirect is not None:
+                return {"redirect": redirect}
+        return await self._serve_chunk(object_id, offset, length)
+
+    async def _serve_chunk(self, object_id: bytes, offset: int,
+                           length: int) -> Optional[Dict[str, Any]]:
         import pickle
 
         obj = self._read_object_for_transfer(object_id)

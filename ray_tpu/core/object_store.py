@@ -106,7 +106,8 @@ def native_lib():
 class SharedMemoryStore:
     """ctypes client of the native arena. Thread-safe (the native side locks)."""
 
-    def __init__(self, path: str, capacity: Optional[int] = None, create: bool = False):
+    def __init__(self, path: str, capacity: Optional[int] = None,
+                 create: bool = False, prefault: bool = True):
         self.path = path
         self._lib = native_lib()
         if create:
@@ -122,8 +123,11 @@ class SharedMemoryStore:
         # WORKERS skip it: a short-lived worker never amortizes a
         # full-arena PTE sweep (~0.3 s of one-core work per 2 GiB —
         # measured 8x slower 50-actor churn windows with per-worker
-        # sweeps) and faults in lazily instead.
-        if create or not os.environ.get("RAY_TPU_WORKER_ID"):
+        # sweeps) and faults in lazily instead. Peer-arena READERS
+        # (same-host cross-nodelet pulls) pass prefault=False: the pages
+        # they touch are already resident in the owner's mapping.
+        if prefault and (create
+                         or not os.environ.get("RAY_TPU_WORKER_ID")):
             self._lib.shm_store_prefault(self._handle, 1 if create else 0)
         else:
             self._prefault_skipped = True

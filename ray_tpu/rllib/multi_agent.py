@@ -1,0 +1,372 @@
+"""Multi-agent RL: MultiRLModule + per-agent episodes + connectors
+(reference: rllib/core/rl_module/multi_rl_module.py:49,
+rllib/env/multi_agent_env.py, rllib/connectors/).
+
+TPU-first shape: each policy (module_id) is an independent flax RLModule
+with its own jitted forward/update; the env→module connector GATHERS
+per-agent observations across env instances and groups them into ONE
+batched forward per module (the MXU-friendly move — N python agents
+become one [B, obs] matmul), then scatters actions back per agent.
+
+Agent ↔ policy wiring is a `policy_mapping_fn(agent_id) -> module_id`,
+so many agents can share one policy (the common parameter-sharing
+setup) or each own one (competitive self-play)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
+from ray_tpu.rllib.rl_module import RLModule
+
+
+class MultiRLModule:
+    """Dict-of-modules container (reference: multi_rl_module.py:49 —
+    there a nested torch Module; here a plain mapping of independent
+    jitted flax modules, which is all the TPU path needs)."""
+
+    def __init__(self, modules: Dict[str, RLModule]):
+        self._modules = dict(modules)
+
+    def __getitem__(self, module_id: str) -> RLModule:
+        return self._modules[module_id]
+
+    def keys(self):
+        return self._modules.keys()
+
+    def items(self):
+        return self._modules.items()
+
+    def init_params(self, seed: int = 0) -> Dict[str, Any]:
+        import jax
+
+        keys = jax.random.split(jax.random.PRNGKey(seed),
+                                len(self._modules))
+        return {mid: m.init_params(k)
+                for (mid, m), k in zip(sorted(self._modules.items()), keys)}
+
+
+# ---------------------------------------------------------------------------
+# Connectors (reference: rllib/connectors/connector_v2.py — composable
+# stages between env and module, shared across algorithms)
+# ---------------------------------------------------------------------------
+class AgentToModuleConnector:
+    """Groups per-agent observations by module id into batched arrays.
+
+    Input: list of (env_idx, agent_id, obs); output: {module_id:
+    (indices, obs_batch)} where indices recover the original order."""
+
+    def __init__(self, policy_mapping_fn: Callable[[str], str]):
+        self.policy_mapping_fn = policy_mapping_fn
+
+    def __call__(self, rows: List[Tuple[int, str, np.ndarray]]
+                 ) -> Dict[str, Tuple[List[int], np.ndarray]]:
+        grouped: Dict[str, Tuple[List[int], List[np.ndarray]]] = {}
+        for i, (_, agent_id, obs) in enumerate(rows):
+            mid = self.policy_mapping_fn(agent_id)
+            idxs, obs_list = grouped.setdefault(mid, ([], []))
+            idxs.append(i)
+            obs_list.append(obs)
+        return {mid: (idxs, np.stack(obs_list).astype(np.float32))
+                for mid, (idxs, obs_list) in grouped.items()}
+
+
+class ModuleToAgentConnector:
+    """Scatters batched module outputs back to per-agent slots."""
+
+    def __call__(self, n_rows: int,
+                 outputs: Dict[str, Tuple[List[int], Any, Any, Any]]
+                 ) -> List[Tuple[int, float, float]]:
+        flat: List[Any] = [None] * n_rows
+        for idxs, actions, logps, values in outputs.values():
+            for j, i in enumerate(idxs):
+                flat[i] = (int(actions[j]), float(logps[j]),
+                           float(values[j]))
+        return flat
+
+
+# ---------------------------------------------------------------------------
+# Per-agent episodes
+# ---------------------------------------------------------------------------
+class MultiAgentEpisode:
+    """Per-agent trajectory accumulator for ONE env episode (reference:
+    rllib/env/multi_agent_episode.py). Agents may act on different steps
+    (turn-based envs); each agent's own trajectory stays contiguous."""
+
+    def __init__(self):
+        self.steps: Dict[str, Dict[str, List[Any]]] = {}
+        self.total_rewards: Dict[str, float] = {}
+
+    def add(self, agent_id: str, obs, action, logp, value, reward,
+            done) -> None:
+        tr = self.steps.setdefault(agent_id, {
+            "obs": [], "actions": [], "logp": [], "values": [],
+            "rewards": [], "dones": []})
+        tr["obs"].append(obs)
+        tr["actions"].append(action)
+        tr["logp"].append(logp)
+        tr["values"].append(value)
+        tr["rewards"].append(reward)
+        tr["dones"].append(done)
+        self.total_rewards[agent_id] = \
+            self.total_rewards.get(agent_id, 0.0) + reward
+
+    def trajectories(self) -> Dict[str, Dict[str, np.ndarray]]:
+        out = {}
+        for agent_id, tr in self.steps.items():
+            out[agent_id] = {
+                "obs": np.stack(tr["obs"]).astype(np.float32),
+                "actions": np.asarray(tr["actions"]),
+                "logp": np.asarray(tr["logp"], np.float32),
+                "values": np.asarray(tr["values"], np.float32),
+                "rewards": np.asarray(tr["rewards"], np.float32),
+                "dones": np.asarray(tr["dones"], np.float32),
+            }
+        return out
+
+
+class MultiAgentEnvRunner:
+    """Steps N multi-agent env instances with one batched forward per
+    module per timestep (the connector pair does the gather/scatter).
+
+    Env protocol (reference: multi_agent_env.py): reset() ->
+    {agent: obs}; step({agent: action}) -> (obs_d, rew_d, done_d) where
+    done_d["__all__"] ends the episode. Only agents present in the obs
+    dict act on a step (turn-based envs supported)."""
+
+    def __init__(self, env_fn, module: MultiRLModule,
+                 policy_mapping_fn, num_envs: int = 4, seed: int = 0):
+        import os
+
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        self.envs = [env_fn() for _ in range(num_envs)]
+        self.module = module
+        self.gather = AgentToModuleConnector(policy_mapping_fn)
+        self.scatter = ModuleToAgentConnector()
+        self.policy_mapping_fn = policy_mapping_fn
+        self.params: Optional[Dict[str, Any]] = None
+        self._key = jax.random.PRNGKey(seed)
+        self.obs: List[Dict[str, Any]] = [env.reset(seed=seed + i)
+                                          for i, env in enumerate(self.envs)]
+        self.episodes = [MultiAgentEpisode() for _ in self.envs]
+        self._done_returns: List[Dict[str, float]] = []
+
+    def set_weights(self, params: Dict[str, Any]) -> None:
+        self.params = params
+
+    def sample(self, num_steps: int) -> Dict[str, List[Dict[str, Any]]]:
+        """num_steps env steps across all instances. Returns module_id ->
+        list of per-agent trajectory dicts (with bootstrap last_values)."""
+        import jax
+
+        for _ in range(num_steps):
+            rows = [(e, aid, np.asarray(obs, np.float32))
+                    for e, od in enumerate(self.obs)
+                    for aid, obs in od.items()]
+            if not rows:
+                break
+            grouped = self.gather(rows)
+            outputs = {}
+            for mid, (idxs, obs_batch) in grouped.items():
+                self._key, sub = jax.random.split(self._key)
+                a, lp, v = self.module[mid].forward_inference(
+                    self.params[mid], obs_batch, sub)
+                outputs[mid] = (idxs, np.asarray(a), np.asarray(lp),
+                                np.asarray(v))
+            flat = self.scatter(len(rows), outputs)
+            # per-env action dicts
+            acts: List[Dict[str, int]] = [{} for _ in self.envs]
+            meta: List[Dict[str, Tuple[float, float]]] = [
+                {} for _ in self.envs]
+            for (e, aid, obs), (action, logp, value) in zip(rows, flat):
+                acts[e][aid] = action
+                meta[e][aid] = (logp, value)
+            for e, env in enumerate(self.envs):
+                if not acts[e]:
+                    continue
+                nobs, rews, dones = env.step(acts[e])
+                ep = self.episodes[e]
+                for aid in acts[e]:
+                    logp, value = meta[e][aid]
+                    ep.add(aid, self.obs[e][aid], acts[e][aid], logp,
+                           value, float(rews.get(aid, 0.0)),
+                           float(dones.get(aid, dones.get("__all__",
+                                                          False))))
+                if dones.get("__all__"):
+                    self._done_returns.append(dict(ep.total_rewards))
+                    self._finished = getattr(self, "_finished", [])
+                    self._finished.append(ep)
+                    self.episodes[e] = MultiAgentEpisode()
+                    self.obs[e] = env.reset()
+                else:
+                    self.obs[e] = nobs
+        # Collect trajectories: finished episodes + in-progress ones
+        # (bootstrapped with the current value estimate).
+        out: Dict[str, List[Dict[str, Any]]] = {mid: []
+                                                for mid in
+                                                self.module.keys()}
+        finished = getattr(self, "_finished", [])
+        self._finished = []
+        for ep in finished:
+            for aid, tr in ep.trajectories().items():
+                tr["last_values"] = np.zeros((1,), np.float32)
+                out[self.policy_mapping_fn(aid)].append(tr)
+        for e, ep in enumerate(self.episodes):
+            trs = ep.trajectories()
+            if not trs:
+                continue
+            for aid, tr in trs.items():
+                if aid in self.obs[e]:
+                    import jax
+
+                    self._key, sub = jax.random.split(self._key)
+                    mid = self.policy_mapping_fn(aid)
+                    _, _, v = self.module[mid].forward_inference(
+                        self.params[mid],
+                        np.asarray(self.obs[e][aid],
+                                   np.float32)[None], sub)
+                    tr["last_values"] = np.asarray(v, np.float32)
+                else:
+                    tr["last_values"] = np.zeros((1,), np.float32)
+                out[self.policy_mapping_fn(aid)].append(tr)
+            self.episodes[e] = MultiAgentEpisode()
+        return out
+
+    def episode_rewards(self) -> List[Dict[str, float]]:
+        out, self._done_returns = self._done_returns, []
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Multi-agent PPO
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class MultiAgentPPOConfig:
+    policies: Dict[str, Tuple[int, int]] = dataclasses.field(
+        default_factory=dict)  # module_id -> (obs_dim, num_actions)
+    policy_mapping_fn: Optional[Callable[[str], str]] = None
+    hidden: Sequence[int] = (64, 64)
+    learner: PPOLearnerConfig = dataclasses.field(
+        default_factory=PPOLearnerConfig)
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 2
+    rollout_length: int = 64
+    seed: int = 0
+    _env_fn: Optional[Callable[[], Any]] = None
+
+    def environment(self, env_fn) -> "MultiAgentPPOConfig":
+        self._env_fn = env_fn
+        return self
+
+    def multi_agent(self, *, policies, policy_mapping_fn
+                    ) -> "MultiAgentPPOConfig":
+        self.policies = dict(policies)
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def build(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO:
+    """PPO over a MultiRLModule: one PPOLearner per policy, shared env
+    runner fleet, per-agent GAE on each trajectory before the per-module
+    minibatch update (reference: the multi-agent PPO stack under
+    rllib/algorithms/ppo + MultiRLModule)."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        assert config._env_fn is not None, "call .environment(...) first"
+        assert config.policies, "call .multi_agent(...) first"
+        self.config = config
+        self.module = MultiRLModule({
+            mid: RLModule(obs_dim, num_actions, config.hidden)
+            for mid, (obs_dim, num_actions) in config.policies.items()})
+        self.learners = {
+            mid: PPOLearner(self.module[mid], config.learner,
+                            seed=config.seed + i)
+            for i, mid in enumerate(sorted(config.policies))}
+        mapping = config.policy_mapping_fn
+        Runner = ray_tpu.remote(MultiAgentEnvRunner)
+        self.runners = [
+            Runner.options(num_cpus=1.0).remote(
+                config._env_fn, self.module, mapping,
+                config.num_envs_per_runner, config.seed + 1000 * i)
+            for i in range(config.num_env_runners)]
+        self._sync_weights()
+        self.iteration = 0
+        self._reward_window: List[Dict[str, float]] = []
+
+    def _sync_weights(self) -> None:
+        params = {mid: ln.get_weights() for mid, ln in
+                  self.learners.items()}
+        ray_tpu.get([r.set_weights.remote(params) for r in self.runners],
+                    timeout=120)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        t0 = time.perf_counter()
+        samples = ray_tpu.get(
+            [r.sample.remote(cfg.rollout_length) for r in self.runners],
+            timeout=600)
+        losses: Dict[str, float] = {}
+        steps = 0
+        for mid, learner in self.learners.items():
+            batches = []
+            for per_runner in samples:
+                for tr in per_runner.get(mid, []):
+                    # per-agent GAE: reuse the [T, N] path with N=1
+                    b2 = {k: (v[:, None] if k != "last_values"
+                              and v.ndim == 1 else v)
+                          for k, v in tr.items()}
+                    if b2["obs"].ndim == 2:
+                        b2["obs"] = tr["obs"][:, None, :]
+                    batches.append(compute_gae(
+                        b2, cfg.learner.gamma, cfg.learner.gae_lambda))
+            if not batches:
+                continue
+            merged = {k: np.concatenate([b[k] for b in batches])
+                      for k in batches[0]}
+            steps += merged["obs"].shape[0]
+            losses[mid] = learner.update([merged])["loss"]
+        self._sync_weights()
+        rewards = ray_tpu.get([r.episode_rewards.remote()
+                               for r in self.runners], timeout=120)
+        for sub in rewards:
+            self._reward_window.extend(sub)
+        self._reward_window = self._reward_window[-100:]
+        mean_rewards = {}
+        for mid in self.learners:
+            vals = [ep[aid] for ep in self._reward_window
+                    for aid in ep
+                    if self.config.policy_mapping_fn(aid) == mid]
+            mean_rewards[mid] = (float(np.mean(vals)) if vals
+                                 else float("nan"))
+        return {
+            "losses": losses,
+            "env_steps_this_iter": steps,
+            "episode_reward_mean": mean_rewards,
+            "time_s": time.perf_counter() - t0,
+        }
+
+    def train(self) -> Dict[str, Any]:
+        self.iteration += 1
+        out = self.training_step()
+        out["training_iteration"] = self.iteration
+        return out
+
+    def get_weights(self) -> Dict[str, Any]:
+        return {mid: ln.get_weights() for mid, ln in self.learners.items()}
+
+    def stop(self) -> None:
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

@@ -27,6 +27,10 @@ class RayTpuConfig:
     # object_manager.h). Objects larger than one chunk stream as concurrent
     # chunk RPCs instead of a single giant frame.
     object_transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # Same-host cross-nodelet pulls memcpy straight out of the source
+    # node's shm arena instead of riding socket RPCs (multi-nodelet-per-
+    # host deployments; the N-nodelet-one-host test/bench topology).
+    object_transfer_same_host_arena: bool = True
     # Pull admission: max chunk RPCs in flight per puller process across ALL
     # concurrent fetches (reference: PullManager admission control,
     # pull_manager.h:49; PushManager max_chunks_in_flight).
@@ -44,6 +48,12 @@ class RayTpuConfig:
     scheduler_top_k_fraction: float = 0.2
     # Idle workers kept warm per (language, runtime-env) key.
     idle_worker_pool_size: int = 2
+    # Booted plain-CPU workers kept in reserve ahead of demand, replenished
+    # in the background when leases drain the idle pool (reference: the
+    # WorkerPool's prestarted workers). 0 disables.
+    worker_prewarm: int = 2
+    # Hard cap on live worker processes per nodelet (prewarm respects it).
+    worker_pool_max: int = 64
     worker_start_timeout_s: float = 60.0
     # Task submission pipelining: specs per batched push RPC, and batches in
     # flight per leased worker (reference: the submitter keeps the worker's

@@ -14,6 +14,8 @@ def test_chunked_cross_node_fetch(monkeypatch):
     monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES",
                        str(1024 * 1024))
     monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_MAX_INFLIGHT_CHUNKS", "4")
+    # force the socket chunk path (same-host arena reads would bypass it)
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_SAME_HOST_ARENA", "0")
     import ray_tpu.utils.config as cfgmod
 
     old_cfg = cfgmod._config
@@ -48,4 +50,60 @@ def test_chunked_cross_node_fetch(monkeypatch):
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+        cfgmod._config = old_cfg
+
+
+def test_peer_chunk_serving_broadcast(monkeypatch):
+    """Broadcast with the same-host arena path disabled: the owner learns
+    chunk locations from pull acks and redirects contending pullers to
+    peers; at least some chunks must arrive peer-to-peer, and every
+    puller's copy must be intact (VERDICT r4 #4 distribution tree)."""
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_CHUNK_BYTES",
+                       str(256 * 1024))
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_MAX_INFLIGHT_CHUNKS", "4")
+    monkeypatch.setenv("RAY_TPU_OBJECT_TRANSFER_SAME_HOST_ARENA", "0")
+    import ray_tpu.utils.config as cfgmod
+
+    old_cfg = cfgmod._config
+    cfgmod._config = None
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={
+        "num_cpus": 2, "object_store_memory": 256 * 2**20})
+    for i in range(3):
+        cluster.add_node(num_cpus=1, resources={f"peer{i}": 1.0},
+                         object_store_memory=256 * 2**20)
+    try:
+        ray_tpu.init(address=cluster.address)
+
+        @ray_tpu.remote
+        class Puller:
+            def pull(self, ref):
+                import hashlib
+
+                from ray_tpu._private import worker as wm
+
+                h = hashlib.sha1(ref.tobytes()).hexdigest()
+                w = wm.global_worker()
+                return h, getattr(w, "_fetch_redirects", 0)
+
+        pullers = [Puller.options(resources={f"peer{i}": 0.5}).remote()
+                   for i in range(3)]
+        arr = np.arange(24 * 2**20 // 8, dtype=np.int64)  # 24 MiB, 96 chunks
+        ref = ray_tpu.put(arr)
+        import hashlib
+
+        expect = hashlib.sha1(arr.tobytes()).hexdigest()
+        out = ray_tpu.get([p.pull.remote(ref) for p in pullers],
+                          timeout=300)
+        assert all(h == expect for h, _ in out), "corrupted broadcast copy"
+        total_redirected = sum(r for _, r in out)
+        assert total_redirected > 0, \
+            "no chunk ever served peer-to-peer under 3-way contention"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
         cfgmod._config = old_cfg

@@ -1,0 +1,115 @@
+"""Multi-agent RLlib: MultiRLModule, per-agent episodes, connector
+batching, and a two-policy competitive learning test (reference:
+rllib/core/rl_module/multi_rl_module.py:49, rllib/env/multi_agent_env.py,
+rllib/connectors/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.examples.chase import (
+    EVADER,
+    PURSUER,
+    ChaseEnv,
+    random_baseline,
+)
+from ray_tpu.rllib.multi_agent import (
+    AgentToModuleConnector,
+    MultiAgentPPOConfig,
+    MultiRLModule,
+)
+from ray_tpu.rllib.rl_module import RLModule
+
+
+def test_connector_groups_by_module():
+    """The env->module connector batches per-agent rows into one forward
+    per module, preserving recovery indices."""
+    conn = AgentToModuleConnector(
+        lambda aid: "shared" if aid.startswith("npc") else aid)
+    rows = [(0, "npc_1", np.zeros(4)), (0, "hero", np.ones(4)),
+            (1, "npc_2", np.full(4, 2.0)), (1, "hero", np.full(4, 3.0))]
+    out = conn(rows)
+    assert set(out) == {"shared", "hero"}
+    idxs, batch = out["shared"]
+    assert idxs == [0, 2] and batch.shape == (2, 4)
+    idxs, batch = out["hero"]
+    assert idxs == [1, 3] and batch[1, 0] == 3.0
+
+
+def test_multi_rl_module_independent_params():
+    m = MultiRLModule({
+        "a": RLModule(6, 5, hidden=(16,)),
+        "b": RLModule(6, 5, hidden=(16,)),
+    })
+    params = m.init_params(seed=0)
+    assert set(params) == {"a", "b"}
+    leaves_a = [float(np.ravel(x)[0])
+                for x in __import__("jax").tree.leaves(params["a"])]
+    leaves_b = [float(np.ravel(x)[0])
+                for x in __import__("jax").tree.leaves(params["b"])]
+    assert leaves_a != leaves_b  # independently initialized
+
+
+def _eval_vs_random(module, weights, trained_agent, n_episodes=100,
+                    seed=9999):
+    """Play the trained policy for ONE agent against a random opponent;
+    returns that agent's mean episode reward."""
+    import jax
+
+    rng = np.random.default_rng(seed)
+    key = jax.random.PRNGKey(seed)
+    env = ChaseEnv()
+    total = 0.0
+    for ep in range(n_episodes):
+        obs = env.reset(seed=seed + ep)
+        done = False
+        while not done:
+            key, sub = jax.random.split(key)
+            a, _, _ = module[trained_agent].forward_inference(
+                weights[trained_agent],
+                np.asarray(obs[trained_agent], np.float32)[None], sub)
+            acts = {aid: int(rng.integers(0, 5)) for aid in env.agents}
+            acts[trained_agent] = int(a[0])
+            obs, rews, dones = env.step(acts)
+            total += rews[trained_agent]
+            done = dones["__all__"]
+    return total / n_episodes
+
+
+def test_two_agent_competitive_learning(ray_start_regular):
+    """Both policies must beat the random-play baseline when evaluated
+    against a random opponent: the pursuer catches faster, the evader
+    survives longer (VERDICT r4 #8 done-criterion)."""
+    base = random_baseline(n_episodes=150)
+
+    from ray_tpu.rllib.learner import PPOLearnerConfig
+
+    cfg = (MultiAgentPPOConfig(
+               hidden=(32, 32),
+               learner=PPOLearnerConfig(lr=1e-3, entropy_coeff=0.003,
+                                        minibatch_size=256),
+               num_env_runners=2, num_envs_per_runner=4,
+               rollout_length=64, seed=3)
+           .environment(ChaseEnv)
+           .multi_agent(
+               policies={PURSUER: (ChaseEnv.obs_dim, ChaseEnv.num_actions),
+                         EVADER: (ChaseEnv.obs_dim, ChaseEnv.num_actions)},
+               policy_mapping_fn=lambda aid: aid))
+    algo = cfg.build()
+    try:
+        for _ in range(35):
+            out = algo.train()
+        weights = algo.get_weights()
+    finally:
+        algo.stop()
+
+    pursuer_score = _eval_vs_random(algo.module, weights, PURSUER)
+    evader_score = _eval_vs_random(algo.module, weights, EVADER)
+    # Meaningful margins over random-vs-random play:
+    assert pursuer_score > base["pursuer_mean"] + 0.3, (
+        f"pursuer {pursuer_score:.2f} vs random {base['pursuer_mean']:.2f}")
+    assert evader_score > base["evader_mean"] + 0.3, (
+        f"evader {evader_score:.2f} vs random {base['evader_mean']:.2f}")
+    # and training emitted per-policy metrics
+    assert set(out["losses"]) <= {PURSUER, EVADER}
+    assert out["env_steps_this_iter"] > 0
