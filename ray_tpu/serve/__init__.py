@@ -85,8 +85,12 @@ def deployment(cls_or_fn=None, **config):
 # ---------------------------------------------------------------------------
 # Lifecycle
 # ---------------------------------------------------------------------------
-def start(http_port: int = 0, _with_http: bool = True):
-    """Ensure the controller (and optionally the HTTP proxy) are running."""
+def start(http_port: int = 0, _with_http: bool = True,
+          grpc_port: Optional[int] = None):
+    """Ensure the controller (and optionally the HTTP proxy) are running.
+    grpc_port != None also starts the gRPC ingress (reference:
+    serve.start(grpc_options=gRPCOptions(...)); 0 picks a free port —
+    read it back with serve.grpc_port())."""
     from ray_tpu.serve._controller import ServeController
 
     try:
@@ -105,6 +109,16 @@ def start(http_port: int = 0, _with_http: bool = True):
                                   num_cpus=0.5).remote(http_port)
             port = ray_tpu.get(proxy.start.remote(), timeout=60)
             ray_tpu.get(controller.set_http_port.remote(port), timeout=30)
+    if grpc_port is not None and ray_tpu.get(
+            controller.get_grpc_port.remote(), timeout=30) is None:
+        from ray_tpu.serve._grpc_proxy import GrpcProxyActor
+
+        GProxy = ray_tpu.remote(GrpcProxyActor)
+        gproxy = GProxy.options(name="SERVE_GRPC_PROXY",
+                                max_concurrency=64,
+                                num_cpus=0.5).remote(grpc_port)
+        gport = ray_tpu.get(gproxy.start.remote(), timeout=60)
+        ray_tpu.get(controller.set_grpc_port.remote(gport), timeout=30)
     return controller
 
 
@@ -174,6 +188,11 @@ def http_port() -> Optional[int]:
     return ray_tpu.get(controller.get_http_port.remote(), timeout=30)
 
 
+def grpc_port() -> Optional[int]:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.get_grpc_port.remote(), timeout=30)
+
+
 def get_deployment_handle(name: str) -> DeploymentHandle:
     return DeploymentHandle(name)
 
@@ -196,7 +215,8 @@ def shutdown() -> None:
         ray_tpu.get(controller.shutdown_all.remote(), timeout=60)
     except Exception:
         pass
-    for actor_name in ("SERVE_PROXY", CONTROLLER_NAME):
+    for actor_name in ("SERVE_PROXY", "SERVE_GRPC_PROXY",
+                       CONTROLLER_NAME):
         try:
             ray_tpu.kill(ray_tpu.get_actor(actor_name))
         except Exception:

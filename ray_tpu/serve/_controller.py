@@ -136,6 +136,12 @@ class ServeController:
     def get_http_port(self) -> Optional[int]:
         return self._http_port
 
+    def set_grpc_port(self, port: int) -> None:
+        self._grpc_port = port
+
+    def get_grpc_port(self) -> Optional[int]:
+        return getattr(self, "_grpc_port", None)
+
     # ------------------------------------------------------------------
     # Reconciliation
     # ------------------------------------------------------------------

@@ -1,0 +1,173 @@
+"""gRPC ingress proxy (reference: serve/_private/proxy.py:521 gRPCProxy).
+
+Shares the router/handle plane with the HTTP proxy: the same controller
+routing table maps application names to deployments, and requests ride
+the same DeploymentHandle path (power-of-two replica choice, autoscaling
+stats). The wire contract is serve_grpc.proto — a generic bytes service
+routed by application name (the reference mounts user-defined servicers;
+this framework's xlang stance is bytes-in/bytes-out with client-side
+encoding). Unary Predict hits the root deployment's __call__;
+PredictStream emits one reply per generator item."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.serve._common import CONTROLLER_NAME
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _decode_payload(request) -> Any:
+    if request.content_type == "application/json" or (
+            not request.content_type and request.payload[:1] in (b"{", b"[")):
+        try:
+            return json.loads(request.payload)
+        except Exception:  # noqa: BLE001
+            pass
+    return bytes(request.payload)
+
+
+def _encode_payload(value, pb) -> Any:
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return pb.PredictReply(payload=bytes(value),
+                               content_type="application/octet-stream")
+    return pb.PredictReply(payload=json.dumps(value).encode(),
+                           content_type="application/json")
+
+
+class GrpcProxyActor:
+    """Async actor hosting a grpc.aio server next to the HTTP proxy."""
+
+    def __init__(self, port: int = 0):
+        self._port = port
+        self._routes: Dict[str, str] = {}  # route_prefix -> deployment
+        self._apps: Dict[str, str] = {}    # app/deployment name -> deployment
+        self._handles: Dict[str, Any] = {}
+        self._version = -1
+        self._server = None
+
+    async def start(self) -> int:
+        import grpc
+
+        from ray_tpu.serve import serve_grpc_pb2 as pb
+        from ray_tpu.serve import serve_grpc_pb2_grpc as pb_grpc
+
+        proxy = self
+
+        class Servicer(pb_grpc.RayTpuServeServicer):
+            async def Predict(self, request, context):
+                handle = await proxy._resolve(request.application)
+                if handle is None:
+                    await context.abort(
+                        grpc.StatusCode.NOT_FOUND,
+                        f"no application {request.application!r}")
+                loop = asyncio.get_running_loop()
+                try:
+                    payload = _decode_payload(request)
+                    out = await loop.run_in_executor(
+                        None, lambda: handle.remote(payload).result(
+                            timeout=600))
+                except Exception as e:  # noqa: BLE001
+                    await context.abort(grpc.StatusCode.INTERNAL, repr(e))
+                return _encode_payload(out, pb)
+
+            async def PredictStream(self, request, context):
+                handle = await proxy._resolve(request.application)
+                if handle is None:
+                    await context.abort(
+                        grpc.StatusCode.NOT_FOUND,
+                        f"no application {request.application!r}")
+                loop = asyncio.get_running_loop()
+                payload = _decode_payload(request)
+                gen = await loop.run_in_executor(
+                    None,
+                    lambda: handle.options(stream=True).remote(payload))
+                it = iter(gen)
+                _END = object()
+
+                def _next():
+                    try:
+                        return next(it)
+                    except StopIteration:
+                        return _END
+
+                while True:
+                    item = await loop.run_in_executor(None, _next)
+                    if item is _END:
+                        return
+                    yield _encode_payload(item, pb)
+
+            async def ListApplications(self, request, context):
+                await proxy._force_refresh()
+                return pb.ListApplicationsReply(
+                    application_names=sorted(proxy._apps))
+
+            async def Healthz(self, request, context):
+                return pb.HealthzReply(message="success")
+
+        self._server = grpc.aio.server()
+        pb_grpc.add_RayTpuServeServicer_to_server(Servicer(), self._server)
+        self._port = self._server.add_insecure_port(
+            f"127.0.0.1:{self._port}")
+        await self._server.start()
+        asyncio.ensure_future(self._route_refresh_loop())
+        logger.info("serve gRPC proxy listening on %d", self._port)
+        return self._port
+
+    def port(self) -> int:
+        return self._port
+
+    # -- routing shared with the HTTP plane ----------------------------
+    async def _route_refresh_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        controller = None
+        while controller is None:
+            try:
+                controller = await loop.run_in_executor(
+                    None, lambda: ray_tpu.get_actor(CONTROLLER_NAME))
+            except Exception:
+                await asyncio.sleep(1.0)
+        self._controller = controller
+        while True:
+            try:
+                self._apply_routing(
+                    await controller.get_routing.remote(self._version))
+            except Exception:
+                logger.exception("grpc route refresh failed")
+            await asyncio.sleep(1.0)
+
+    def _apply_routing(self, routing) -> None:
+        from ray_tpu.serve._handle import DeploymentHandle
+
+        if routing is None:
+            return
+        self._version = routing["version"]
+        apps: Dict[str, str] = {}
+        for name, info in routing["deployments"].items():
+            if info.get("route_prefix"):
+                apps[name] = name
+            if name not in self._handles:
+                self._handles[name] = DeploymentHandle(name)
+        self._apps = apps
+
+    async def _force_refresh(self) -> None:
+        controller = getattr(self, "_controller", None)
+        if controller is None:
+            return
+        try:
+            self._apply_routing(await controller.get_routing.remote(-1))
+        except Exception:
+            logger.exception("forced grpc route refresh failed")
+
+    async def _resolve(self, application: str) -> Optional[Any]:
+        if application not in self._apps:
+            await self._force_refresh()
+        name = self._apps.get(application)
+        if name is None and application in self._handles:
+            name = application  # direct deployment-name addressing
+        return self._handles.get(name) if name else None

@@ -1,0 +1,103 @@
+# Client stub + servicer registration for serve_grpc.proto, maintained
+# by hand in the standard grpc-python codegen shape (the image has protoc
+# for message codegen but not the grpc python plugin). Mirrors exactly
+# what `python -m grpc_tools.protoc --grpc_python_out` would emit.
+"""Client and server classes corresponding to protobuf-defined services."""
+import grpc
+
+from ray_tpu.serve import serve_grpc_pb2 as serve__grpc__pb2
+
+_SERVICE = "ray_tpu.serve.RayTpuServe"
+
+
+class RayTpuServeStub(object):
+    """Generic bytes-in/bytes-out serve ingress."""
+
+    def __init__(self, channel):
+        """Constructor.
+
+        Args:
+            channel: A grpc.Channel.
+        """
+        self.Predict = channel.unary_unary(
+            f"/{_SERVICE}/Predict",
+            request_serializer=serve__grpc__pb2.PredictRequest
+            .SerializeToString,
+            response_deserializer=serve__grpc__pb2.PredictReply.FromString,
+        )
+        self.PredictStream = channel.unary_stream(
+            f"/{_SERVICE}/PredictStream",
+            request_serializer=serve__grpc__pb2.PredictRequest
+            .SerializeToString,
+            response_deserializer=serve__grpc__pb2.PredictReply.FromString,
+        )
+        self.ListApplications = channel.unary_unary(
+            f"/{_SERVICE}/ListApplications",
+            request_serializer=serve__grpc__pb2.ListApplicationsRequest
+            .SerializeToString,
+            response_deserializer=serve__grpc__pb2.ListApplicationsReply
+            .FromString,
+        )
+        self.Healthz = channel.unary_unary(
+            f"/{_SERVICE}/Healthz",
+            request_serializer=serve__grpc__pb2.HealthzRequest
+            .SerializeToString,
+            response_deserializer=serve__grpc__pb2.HealthzReply.FromString,
+        )
+
+
+class RayTpuServeServicer(object):
+    """Generic bytes-in/bytes-out serve ingress."""
+
+    def Predict(self, request, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        context.set_details("Method not implemented!")
+        raise NotImplementedError("Method not implemented!")
+
+    def PredictStream(self, request, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        context.set_details("Method not implemented!")
+        raise NotImplementedError("Method not implemented!")
+
+    def ListApplications(self, request, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        context.set_details("Method not implemented!")
+        raise NotImplementedError("Method not implemented!")
+
+    def Healthz(self, request, context):
+        context.set_code(grpc.StatusCode.UNIMPLEMENTED)
+        context.set_details("Method not implemented!")
+        raise NotImplementedError("Method not implemented!")
+
+
+def add_RayTpuServeServicer_to_server(servicer, server):
+    rpc_method_handlers = {
+        "Predict": grpc.unary_unary_rpc_method_handler(
+            servicer.Predict,
+            request_deserializer=serve__grpc__pb2.PredictRequest.FromString,
+            response_serializer=serve__grpc__pb2.PredictReply
+            .SerializeToString,
+        ),
+        "PredictStream": grpc.unary_stream_rpc_method_handler(
+            servicer.PredictStream,
+            request_deserializer=serve__grpc__pb2.PredictRequest.FromString,
+            response_serializer=serve__grpc__pb2.PredictReply
+            .SerializeToString,
+        ),
+        "ListApplications": grpc.unary_unary_rpc_method_handler(
+            servicer.ListApplications,
+            request_deserializer=serve__grpc__pb2.ListApplicationsRequest
+            .FromString,
+            response_serializer=serve__grpc__pb2.ListApplicationsReply
+            .SerializeToString,
+        ),
+        "Healthz": grpc.unary_unary_rpc_method_handler(
+            servicer.Healthz,
+            request_deserializer=serve__grpc__pb2.HealthzRequest.FromString,
+            response_serializer=serve__grpc__pb2.HealthzReply
+            .SerializeToString,
+        ),
+    }
+    generic_handler = grpc.method_handlers_generic_handler(
+        _SERVICE, rpc_method_handlers)
+    server.add_generic_rpc_handlers((generic_handler,))
