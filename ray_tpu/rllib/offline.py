@@ -86,7 +86,15 @@ class OfflineData:
 
     def _table(self) -> Dict[str, np.ndarray]:
         if self._cache is None:
-            blocks = list(self.dataset.iter_blocks())
+            from ray_tpu.data.block import as_numpy_block
+
+            # read_parquet yields Arrow-backed blocks; the learner wants
+            # the numpy staging format (list columns -> object arrays).
+            blocks = [as_numpy_block(b)
+                      for b in self.dataset.iter_blocks()]
+            if not blocks:
+                raise ValueError(
+                    "offline dataset is empty (no transition blocks)")
             out: Dict[str, np.ndarray] = {}
             for key in blocks[0]:
                 vals = [b[key] for b in blocks]
