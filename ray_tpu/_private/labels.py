@@ -1,0 +1,59 @@
+"""Node label selectors (reference: src/ray/common/scheduling/
+label_selector.h — LABEL_OPERATOR_IN / NOT_IN / EXISTS / DOES_NOT_EXIST
+with the string syntax the python API exposes).
+
+A selector is {key: constraint}; constraint forms:
+  "v"          exact match
+  "!v"         not equal
+  "in(a,b)"    value in set
+  "!in(a,b)"   value not in set
+  "exists"     key present (any value)
+  "!exists"    key absent
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+def validate_label_selector(selector: Optional[Dict[str, str]]) -> None:
+    if selector is None:
+        return
+    if not isinstance(selector, dict):
+        raise TypeError(
+            f"label_selector must be a dict, got {type(selector).__name__}")
+    for k, v in selector.items():
+        if not isinstance(k, str) or not k:
+            raise ValueError(f"label key must be a non-empty str: {k!r}")
+        if not isinstance(v, str):
+            raise ValueError(
+                f"label constraint for {k!r} must be a str, got {v!r}")
+        if v.startswith("in(") or v.startswith("!in("):
+            if not v.endswith(")"):
+                raise ValueError(f"malformed set constraint: {v!r}")
+
+
+def _constraint_matches(constraint: str, value: Optional[str]) -> bool:
+    if constraint == "exists":
+        return value is not None
+    if constraint == "!exists":
+        return value is None
+    if constraint.startswith("in(") and constraint.endswith(")"):
+        allowed = [s.strip() for s in constraint[3:-1].split(",")]
+        return value is not None and value in allowed
+    if constraint.startswith("!in(") and constraint.endswith(")"):
+        blocked = [s.strip() for s in constraint[4:-1].split(",")]
+        return value is not None and value not in blocked
+    if constraint.startswith("!"):
+        return value is not None and value != constraint[1:]
+    return value == constraint
+
+
+def match_label_selector(selector: Optional[Dict[str, str]],
+                         labels: Optional[Dict[str, str]]) -> bool:
+    """Every constraint must hold against the node's labels."""
+    if not selector:
+        return True
+    labels = labels or {}
+    return all(_constraint_matches(c, labels.get(k))
+               for k, c in selector.items())

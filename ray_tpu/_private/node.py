@@ -97,6 +97,7 @@ class Node:
         object_store_memory: Optional[int] = None,
         session_dir: Optional[str] = None,
         node_name: str = "",
+        labels: Optional[Dict[str, str]] = None,
     ):
         self.head = head
         self.session_id = f"session_{uuid.uuid4().hex[:12]}"
@@ -139,6 +140,8 @@ class Node:
         ]
         if resources is not None:
             cmd += ["--resources", json.dumps(resources)]
+        if labels:
+            cmd += ["--labels", json.dumps(labels)]
         if object_store_memory:
             cmd += ["--object-store-memory", str(object_store_memory)]
         self._start_process(cmd, f"nodelet-{node_name or 'head'}")

@@ -115,6 +115,15 @@ class TaskSpec:
     # "" = normal object plane; "device" = returns stay in the executor's HBM
     # and move via the device-object plane (experimental/device_objects.py).
     tensor_transport: str = ""
+    # Node label constraints (reference: label_selector.h; matcher in
+    # _private/labels.py). Scheduling only places this task/actor on
+    # nodes whose labels satisfy every constraint.
+    label_selector: Optional[Dict[str, str]] = None
+    # Tracing context captured at submission (reference: tracing_helper.py
+    # injects the OpenTelemetry context around submit/execute): the id of
+    # the user span active in the SUBMITTER, restored as the execution
+    # side's parent so spans chain across process hops automatically.
+    trace_parent: Optional[str] = None
 
     def scheduling_key(self) -> Tuple:
         """Lease-reuse key (reference: SchedulingKey in
@@ -122,7 +131,9 @@ class TaskSpec:
         The full strategy identity matters: PG bundles with different indexes
         or different affinity nodes must not share a lease pool."""
         env_key = repr(sorted((self.runtime_env or {}).items()))
-        return (self.resources.key(), env_key, repr(self.scheduling_strategy))
+        sel_key = repr(sorted((self.label_selector or {}).items()))
+        return (self.resources.key(), env_key,
+                repr(self.scheduling_strategy), sel_key)
 
     def return_ids(self) -> List[ObjectID]:
         return [

@@ -100,6 +100,37 @@ class ShmMarker:
         self.node_id = node_id
 
 
+def _enter_trace_context(spec):
+    """Make the submitter's span the execution side's current span, so
+    spans opened inside the task chain across the hop. Returns a reset
+    token (None when the spec carries no context)."""
+    if not getattr(spec, "trace_parent", None):
+        return None
+    from ray_tpu.util import tracing
+
+    return tracing._current_span.set(spec.trace_parent)
+
+
+def _exit_trace_context(token) -> None:
+    if token is None:
+        return
+    from ray_tpu.util import tracing
+
+    try:
+        tracing._current_span.reset(token)
+    except ValueError:
+        pass  # executor thread changed context (generators): drop
+
+
+def _current_trace_parent():
+    """The submitter's active user span id (None when tracing is idle) —
+    captured into every TaskSpec so execution-side spans parent across
+    the process hop (reference: tracing_helper.py context injection)."""
+    from ray_tpu.util import tracing
+
+    return tracing.current_span_id()
+
+
 class LeasePool:
     """Leased-worker pool for one SchedulingKey; pipelines queued tasks onto
     leased workers and returns leases when drained (reference:
@@ -120,6 +151,7 @@ class LeasePool:
         self.queue: asyncio.Queue = asyncio.Queue()
         self.num_leased = 0
         self.requesting = 0
+        self.label_selector = getattr(spec_template, "label_selector", None)
 
     def maybe_scale_up(self) -> None:
         cfg = get_config()
@@ -167,7 +199,18 @@ class LeasePool:
                 except Exception:
                     pass  # assigned node gone — fall through to a GCS pick
             pick = await w.gcs_client.call(
-                "pick_node", resources=self.resources, strategy="spread")
+                "pick_node", resources=self.resources, strategy="spread",
+                label_selector=self.label_selector)
+            if pick is None:
+                return None, None
+            return await w.nodelet_client_for_node(pick["node_id"]), None
+        if self.label_selector:
+            # Labels are a cluster property: route through the GCS's
+            # composite policy (feasibility incl. label match, then
+            # hybrid score) instead of the local-first probe.
+            pick = await w.gcs_client.call(
+                "pick_node", resources=self.resources,
+                label_selector=self.label_selector)
             if pick is None:
                 return None, None
             return await w.nodelet_client_for_node(pick["node_id"]), None
@@ -872,14 +915,17 @@ class Worker:
 
     def record_task_event(self, spec: TaskSpec, start_ts: float,
                           end_ts: float, ok: bool) -> None:
-        self.record_event({
+        event = {
             "task_id": spec.task_id.hex(),
             "name": spec.function_name,
             "type": spec.task_type.name,
             "start_ts": start_ts,
             "end_ts": end_ts,
             "ok": ok,
-        })
+        }
+        if spec.trace_parent:
+            event["parent"] = spec.trace_parent
+        self.record_event(event)
 
     async def _task_event_loop(self) -> None:
         while not self._shutdown:
@@ -1542,7 +1588,11 @@ class Worker:
         retry_exceptions: bool = False,
         runtime_env: Optional[Dict[str, Any]] = None,
         function_name: str = "",
+        label_selector: Optional[Dict[str, str]] = None,
     ) -> List[ObjectRef]:
+        from ray_tpu._private.labels import validate_label_selector
+
+        validate_label_selector(label_selector)
         fn_key = self.function_manager.export(fn, self.job_id.hex())
         p_args, p_kwargs = self._process_args(args, kwargs)
         cfg = get_config()
@@ -1562,6 +1612,8 @@ class Worker:
             owner_address=self.address,
             runtime_env=_prepare_runtime_env(runtime_env,
                                               self._gcs_call_sync),
+            label_selector=label_selector,
+            trace_parent=_current_trace_parent(),
         )
         return_ids = self.task_manager.add_pending(spec)
         if num_returns == -1:
@@ -1947,7 +1999,11 @@ class Worker:
         runtime_env: Optional[Dict[str, Any]] = None,
         scheduling_strategy: Any = None,
         get_if_exists: bool = False,
+        label_selector: Optional[Dict[str, str]] = None,
     ) -> ActorID:
+        from ray_tpu._private.labels import validate_label_selector
+
+        validate_label_selector(label_selector)
         actor_id = ActorID.of(self.job_id)
         cls_key = self.function_manager.export(cls, self.job_id.hex())
         p_args, p_kwargs = self._process_args(args, kwargs)
@@ -1969,6 +2025,8 @@ class Worker:
             max_task_retries=max_task_retries,
             runtime_env=_prepare_runtime_env(runtime_env,
                                               self._gcs_call_sync),
+            label_selector=label_selector,
+            trace_parent=_current_trace_parent(),
         )
         register = self.gcs_client.call_retrying(
             "register_actor",
@@ -2046,6 +2104,7 @@ class Worker:
             seq_no=seq,
             concurrency_group=concurrency_group,
             tensor_transport=tensor_transport,
+            trace_parent=_current_trace_parent(),
         )
         return_ids = self.task_manager.add_pending(spec)
         if num_returns == -1:
@@ -2427,6 +2486,7 @@ class Worker:
     def _execute_actor_task_sync(self, spec: TaskSpec, method: Any) -> Dict[str, Any]:
         t0 = time.time()
         ok = True
+        trace_tok = _enter_trace_context(spec)
         try:
             texec = (time.perf_counter_ns()
                      if os.environ.get("RAY_TPU_PUSH_TRACE") else 0)
@@ -2445,6 +2505,7 @@ class Worker:
             return {"results": [self._error_result(e)] * max(1, spec.num_returns)}
         finally:
             self._current_task_id = None
+            _exit_trace_context(trace_tok)
             self.record_task_event(spec, t0, time.time(), ok)
 
     def _execute_task_sync(self, spec: TaskSpec) -> Dict[str, Any]:
@@ -2453,6 +2514,7 @@ class Worker:
             return {"cancelled": True, "results": []}
         t0 = time.time()
         ok = True
+        trace_tok = _enter_trace_context(spec)
         try:
             fn = self.function_manager.fetch(spec.function_key)
             args, kwargs = self._resolve_spec_args_sync(spec)
@@ -2467,6 +2529,7 @@ class Worker:
             return {"results": [self._error_result(e)] * max(1, spec.num_returns)}
         finally:
             self._current_task_id = None
+            _exit_trace_context(trace_tok)
             self.record_task_event(spec, t0, time.time(), ok)
 
     def _spec_arg_ref_ids(self, spec: TaskSpec) -> List[ObjectID]:

@@ -145,6 +145,7 @@ class ActorClass:
             runtime_env=opts.get("runtime_env"),
             scheduling_strategy=to_internal(opts.get("scheduling_strategy")),
             get_if_exists=bool(opts.get("get_if_exists", False)),
+            label_selector=opts.get("label_selector"),
         )
         return ActorHandle(
             actor_id,

@@ -37,7 +37,8 @@ class Cluster:
     def add_node(self, num_cpus: float = 4.0,
                  resources: Optional[Dict[str, float]] = None,
                  object_store_memory: Optional[int] = None,
-                 node_name: str = "") -> Node:
+                 node_name: str = "",
+                 labels: Optional[Dict[str, str]] = None) -> Node:
         self._node_counter += 1
         total = {"CPU": float(num_cpus)}
         for k, v in (resources or {}).items():
@@ -50,6 +51,7 @@ class Cluster:
             object_store_memory=object_store_memory,
             session_dir=(self.head_node.session_dir
                          if self.head_node is not None else None),
+            labels=labels,
             node_name=node_name or f"node{self._node_counter}",
         )
         if self.head_node is not None:

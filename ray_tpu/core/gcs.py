@@ -526,15 +526,22 @@ class GcsServer:
 
     def _pick_node(self, resources: Dict[str, float],
                    strategy: str = "hybrid",
-                   exclude: Optional[set] = None) -> Optional[NodeInfo]:
-        """Hybrid policy: prefer packing onto the most-utilized node that still
-        fits (reference: hybrid_scheduling_policy.h:50); spread = least
-        utilized first."""
+                   exclude: Optional[set] = None,
+                   label_selector: Optional[Dict[str, str]] = None
+                   ) -> Optional[NodeInfo]:
+        """Composite policy (reference: composite_scheduling_policy.h:33 —
+        feasibility filters then a placement score): label-selector and
+        resource feasibility first (label_selector.h semantics via
+        _private/labels.py), then hybrid pack-most-utilized
+        (hybrid_scheduling_policy.h:50) or spread least-utilized."""
+        from ray_tpu._private.labels import match_label_selector
+
         req = ResourceSet(resources)
         candidates = [
             n for n in self._alive_nodes()
             if (exclude is None or n.node_id not in exclude)
             and req.fits_in(n.resources_available)
+            and match_label_selector(label_selector, n.labels)
         ]
         if not candidates:
             return None
@@ -559,10 +566,12 @@ class GcsServer:
     async def rpc_pick_node(
         self, resources: Dict[str, float], strategy: str = "hybrid",
         exclude: Optional[List[bytes]] = None,
+        label_selector: Optional[Dict[str, str]] = None,
     ) -> Optional[Dict[str, Any]]:
         node = self._pick_node(
             resources, strategy,
-            {NodeID(e) for e in exclude} if exclude else None)
+            {NodeID(e) for e in exclude} if exclude else None,
+            label_selector=label_selector)
         if node is None:
             return None
         return {"node_id": node.node_id.binary(), "address": node.address,
@@ -645,9 +654,13 @@ class GcsServer:
                 if node is None and strategy.soft:
                     node = self._pick_node(spec.resources)
             elif isinstance(strategy, SpreadStrategy):
-                node = self._pick_node(spec.resources, strategy="spread")
+                node = self._pick_node(
+                    spec.resources, strategy="spread",
+                    label_selector=getattr(spec, "label_selector", None))
             else:
-                node = self._pick_node(spec.resources)
+                node = self._pick_node(
+                    spec.resources,
+                    label_selector=getattr(spec, "label_selector", None))
             if node is None:
                 if time.monotonic() > deadline:
                     await self._actor_dead(

@@ -160,12 +160,16 @@ class Nodelet:
         resources: Optional[Dict[str, float]] = None,
         object_store_memory: Optional[int] = None,
         node_name: str = "",
+        labels: Optional[Dict[str, str]] = None,
     ):
         self.node_id = NodeID.from_random()
         self.gcs_address = gcs_address
         self.session_dir = session_dir
         self.server = RpcServer(host, port)
         self.node_name = node_name or self.node_id.hex()[:8]
+        # Node labels (reference: the static node labels label_selector.h
+        # matches against); node_name always present for affinity UX.
+        self.labels = {**(labels or {}), "node_name": self.node_name}
         # Per-node worker-log namespace (session_dir may be shared across
         # nodes on one filesystem).
         self._worker_log_dir = os.path.join(
@@ -240,7 +244,7 @@ class Nodelet:
             address=addr,
             resources=self.resources_total,
             object_store_path=self.store_path,
-            labels={"node_name": self.node_name},
+            labels=self.labels,
         )
         self._background.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._background.append(
@@ -445,6 +449,7 @@ class Nodelet:
         env["RAY_TPU_STORE_PATH"] = self.store_path
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        env["RAY_TPU_NODE_NAME"] = self.node_name
         repo_root = os.path.dirname(
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -1072,7 +1077,7 @@ class Nodelet:
                         address=(self.server.host, self.server.port),
                         resources=self.resources_total,
                         object_store_path=self.store_path,
-                        labels={"node_name": self.node_name},
+                        labels=self.labels,
                     )
             except Exception as e:
                 logger.warning("heartbeat failed: %r", e)
@@ -1185,6 +1190,7 @@ def main() -> None:  # pragma: no cover - exercised via subprocess
     parser.add_argument("--resources", default="")
     parser.add_argument("--object-store-memory", type=int, default=0)
     parser.add_argument("--node-name", default="")
+    parser.add_argument("--labels", default="")
     args = parser.parse_args()
 
     resources = json.loads(args.resources) if args.resources else None
@@ -1200,6 +1206,7 @@ def main() -> None:  # pragma: no cover - exercised via subprocess
             resources=resources,
             object_store_memory=args.object_store_memory or None,
             node_name=args.node_name,
+            labels=json.loads(args.labels) if args.labels else None,
         )
         await nodelet.start()
         stop = asyncio.Event()

@@ -58,6 +58,7 @@ class RemoteFunction:
             max_retries=opts.get("max_retries"),
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             runtime_env=opts.get("runtime_env"),
+            label_selector=opts.get("label_selector"),
             function_name=self._fn.__name__,
         )
         if num_returns in (1, -1):
