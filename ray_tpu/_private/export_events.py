@@ -1,0 +1,99 @@
+"""Export events: durable structured event files for external ingestion
+(reference: src/ray/util/event.h RayExportEvent + the
+src/ray/protobuf/export_*.proto schemas — one JSONL file per source type
+under the session's export_events/ dir, size-rotated, written by the
+component that owns the state transition).
+
+Consumers tail `export_events/event_EXPORT_<TYPE>.log`; each line is a
+self-contained JSON object:
+  {"event_id", "source_type", "timestamp", "event_data": {...}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+SOURCE_TYPES = (
+    "EXPORT_TASK",
+    "EXPORT_ACTOR",
+    "EXPORT_NODE",
+    "EXPORT_JOB",
+    "EXPORT_PLACEMENT_GROUP",
+    "EXPORT_DRIVER_JOB",
+)
+
+
+class ExportEventLogger:
+    """Per-process JSONL event writer with size rotation (one backup,
+    like the reference's spdlog rotating sink)."""
+
+    def __init__(self, directory: str,
+                 max_bytes: int = 50 * 1024 * 1024):
+        self.directory = directory
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._files: Dict[str, Any] = {}
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, source_type: str) -> str:
+        return os.path.join(self.directory,
+                            f"event_{source_type}.log")
+
+    def emit(self, source_type: str, event_data: Dict[str, Any]) -> None:
+        if source_type not in SOURCE_TYPES:
+            raise ValueError(f"unknown export source {source_type!r}")
+        line = json.dumps({
+            "event_id": uuid.uuid4().hex,
+            "source_type": source_type,
+            "timestamp": time.time(),
+            "event_data": event_data,
+        }, default=str) + "\n"
+        path = self._path(source_type)
+        with self._lock:
+            f = self._files.get(source_type)
+            if f is None:
+                f = self._files[source_type] = open(path, "a",
+                                                    buffering=1)
+            try:
+                if f.tell() + len(line) > self.max_bytes:
+                    f.close()
+                    backup = path + ".1"
+                    if os.path.exists(backup):
+                        os.unlink(backup)
+                    os.replace(path, backup)
+                    f = self._files[source_type] = open(path, "a",
+                                                        buffering=1)
+                f.write(line)
+            except OSError:
+                pass  # export is best-effort; never block the component
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._files.values():
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            self._files.clear()
+
+
+_logger: Optional[ExportEventLogger] = None
+
+
+def get_export_logger(session_dir: str) -> Optional[ExportEventLogger]:
+    """Process-wide logger, gated by config (reference: the
+    RAY_enable_export_api_write flag family)."""
+    from ray_tpu.utils.config import get_config
+
+    if not get_config().enable_export_events:
+        return None
+    global _logger
+    if _logger is None:
+        _logger = ExportEventLogger(
+            os.path.join(session_dir, "export_events"))
+    return _logger

@@ -236,7 +236,8 @@ class LeasePool:
             backoff = 0.05
             while True:
                 lease = await client.call(
-                    "lease_worker", resources=self.resources,
+                    "lease_worker", owner=list(w.address),
+                    resources=self.resources,
                     runtime_env=self.runtime_env, lifetime="task",
                     pg_bundle=pg_bundle, block=False, timeout=timeout)
                 if lease.get("ok"):
@@ -248,14 +249,16 @@ class LeasePool:
                     # Single-node cluster: block on the local nodelet (event-
                     # driven wakeup) instead of polling.
                     lease = await client.call(
-                        "lease_worker", resources=self.resources,
+                        "lease_worker", owner=list(w.address),
+                    resources=self.resources,
                         runtime_env=self.runtime_env, lifetime="task",
                         pg_bundle=pg_bundle, block=True, timeout=timeout)
                     return lease, client
                 for n in others:
                     remote = await w.nodelet_client_for_node(n["node_id"])
                     lease = await remote.call(
-                        "lease_worker", resources=self.resources,
+                        "lease_worker", owner=list(w.address),
+                    resources=self.resources,
                         runtime_env=self.runtime_env, lifetime="task",
                         pg_bundle=pg_bundle, block=False, timeout=timeout)
                     if lease.get("ok"):
@@ -266,7 +269,8 @@ class LeasePool:
                 await asyncio.sleep(backoff)
                 backoff = min(backoff * 2, 0.5)
         lease = await client.call(
-            "lease_worker", resources=self.resources,
+            "lease_worker", owner=list(w.address),
+                    resources=self.resources,
             runtime_env=self.runtime_env, lifetime="task",
             pg_bundle=pg_bundle, block=True, timeout=timeout)
         return lease, client

@@ -264,7 +264,22 @@ class GcsServer:
 
         self.task_events: "deque" = deque(maxlen=20_000)
         self.storage = GcsStorage(persist_path)
+        # Durable export-event files for external ingestion (reference:
+        # src/ray/util/event.h + export_*.proto; gated by config).
+        from ray_tpu._private.export_events import get_export_logger
+
+        export_dir = (os.path.dirname(persist_path) if persist_path
+                      else os.path.join("/tmp/ray_tpu", "default"))
+        self.export = get_export_logger(export_dir)
         self._restore()
+
+    def _export_event(self, source_type: str,
+                      data: Dict[str, Any]) -> None:
+        if self.export is not None:
+            try:
+                self.export.emit(source_type, data)
+            except Exception:  # noqa: BLE001
+                pass  # export is observability, never control flow
 
     def _restore(self) -> None:
         snap = self.storage.load()
@@ -362,6 +377,9 @@ class GcsServer:
                                    object_store_path, labels or {})
         await self.pubsub.publish("nodes", {"event": "added", "node_id": node_id,
                                             "address": address})
+        self._export_event("EXPORT_NODE", {
+            "node_id": nid.hex(), "state": "ALIVE",
+            "resources": resources, "labels": labels or {}})
         logger.info("node %s registered: %s", nid, resources)
         return {"ok": True}
 
@@ -448,6 +466,9 @@ class GcsServer:
 
     async def _mark_node_dead(self, info: NodeInfo, reason: str) -> None:
         info.alive = False
+        self._export_event("EXPORT_NODE", {
+            "node_id": info.node_id.hex(), "state": "DEAD",
+            "reason": reason})
         logger.warning("node %s dead: %s", info.node_id, reason)
         await self.pubsub.publish(
             "nodes", {"event": "removed", "node_id": info.node_id.binary(),
@@ -508,6 +529,8 @@ class GcsServer:
         if job_id in self.jobs:
             self.jobs[job_id]["state"] = "FINISHED"
             self.jobs[job_id]["end_time"] = time.time()
+            self._export_event("EXPORT_DRIVER_JOB", {
+                "job_id": job_id, "state": "FINISHED"})
         # Non-detached actors of the job die with it.
         for actor in list(self.actors.values()):
             if (not actor.detached and actor.state != ACTOR_DEAD
@@ -694,6 +717,11 @@ class GcsServer:
                         info, f"creation failed: {result.get('error')}")
                     return
                 info.state = ACTOR_ALIVE
+                self._export_event("EXPORT_ACTOR", {
+                    "actor_id": info.actor_id.hex(), "state": "ALIVE",
+                    "name": info.name,
+                    "node_id": info.node_id.hex() if info.node_id
+                    else None})
                 self.mark_dirty()
                 info.address = worker_addr
                 info.node_id = node.node_id
@@ -713,6 +741,9 @@ class GcsServer:
 
     async def _actor_dead(self, info: ActorInfo, cause: str) -> None:
         info.state = ACTOR_DEAD
+        self._export_event("EXPORT_ACTOR", {
+            "actor_id": info.actor_id.hex(), "state": "DEAD",
+            "name": info.name, "death_cause": cause})
         self.mark_dirty()
         info.death_cause = cause
         info.address = None
@@ -811,6 +842,9 @@ class GcsServer:
         ok = await self._schedule_pg(info)
         if ok:
             info.state = "CREATED"
+            self._export_event("EXPORT_PLACEMENT_GROUP", {
+                "pg_id": info.pg_id.hex(), "state": "CREATED",
+                "strategy": info.strategy})
             self.mark_dirty()
             await self.pubsub.publish("placement_groups",
                                       {"event": "created", "pg_id": pg_id})
@@ -833,6 +867,9 @@ class GcsServer:
                     # scheduling race (membership check + bundle return).
                     if await self._schedule_pg(info):
                         info.state = "CREATED"
+                        self._export_event("EXPORT_PLACEMENT_GROUP", {
+                            "pg_id": info.pg_id.hex(), "state": "CREATED",
+                            "strategy": info.strategy})
                         self.mark_dirty()
                         await self.pubsub.publish(
                             "placement_groups",
@@ -916,6 +953,8 @@ class GcsServer:
         if info is None:
             return {"ok": False}
         info.state = "REMOVED"  # in-flight retry scheduling must not revive it
+        self._export_event("EXPORT_PLACEMENT_GROUP", {
+            "pg_id": info.pg_id.hex(), "state": "REMOVED"})
         self.mark_dirty()
         for i, nid in info.bundle_nodes.items():
             try:
@@ -951,6 +990,8 @@ class GcsServer:
     async def rpc_report_task_events(
             self, events: List[Dict[str, Any]]) -> None:
         self.task_events.extend(events)
+        for ev in events:
+            self._export_event("EXPORT_TASK", ev)
 
     async def rpc_list_task_events(
             self, limit: int = 1000) -> List[Dict[str, Any]]:

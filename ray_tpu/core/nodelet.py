@@ -624,6 +624,7 @@ class Nodelet:
         lifetime: str = "task",
         pg_bundle: Optional[Tuple[bytes, int]] = None,
         block: bool = True,
+        owner: Optional[List[Any]] = None,
     ) -> Dict[str, Any]:
         req = ResourceSet(resources)
         num_tpus = float(resources.get("TPU", 0) or 0)
@@ -661,6 +662,7 @@ class Nodelet:
                     return {"ok": False, "error": f"worker start failed: {e!r}"}
                 worker.leased = True
                 worker.lifetime = lifetime
+                worker.lease_owner = tuple(owner) if owner else None
                 worker.resources = req
                 worker.pg_bundle = pg_bundle
                 worker.tpu_chips = chips if num_tpus >= 1 else []
@@ -1103,9 +1105,12 @@ class Nodelet:
         above the usage threshold, kill the most recently leased task
         worker — its task retries elsewhere/later; actors are spared first
         (their state is harder to recover)."""
+        from ray_tpu.core.oom_policies import get_policy
+
         cfg = get_config()
         if cfg.memory_usage_threshold <= 0:
             return
+        policy = get_policy(cfg.oom_killer_policy)
         while not self._shutting_down:
             await asyncio.sleep(cfg.memory_monitor_interval_s)
             usage = self._memory_usage()
@@ -1115,14 +1120,14 @@ class Nodelet:
                       if w.leased and w.proc.poll() is None]
             if not leased:
                 continue
-            tasks_first = sorted(
-                leased, key=lambda w: (w.lifetime != "task", -w.last_idle))
-            victim = tasks_first[0]
+            victim = policy.select(leased)
+            if victim is None:
+                continue
             logger.warning(
                 "memory pressure %.0f%% >= %.0f%%: killing worker %s "
-                "(retriable-LIFO)", usage * 100,
+                "(%s policy)", usage * 100,
                 cfg.memory_usage_threshold * 100,
-                victim.worker_id.hex()[:8])
+                victim.worker_id.hex()[:8], policy.name)
             try:
                 victim.proc.kill()
             except Exception:

@@ -1,0 +1,85 @@
+"""Pluggable OOM worker-killing policies (reference:
+src/ray/raylet/worker_killing_policy.h:69 RetriableLIFOWorkerKillingPolicy
++ worker_killing_policy_group_by_owner.h — the set C19 in SURVEY §2.1).
+
+A policy picks the victim among LEASED, live workers when the node
+crosses the memory threshold. Selection invariants shared by all
+policies: task workers before actor workers (a killed task retries;
+actor state is harder to recover), and the chosen worker is returned to
+the monitor loop which kills + reaps it.
+
+Select with config `oom_killer_policy`:
+  "retriable_lifo"  (default) most recently leased task worker first
+  "group_by_owner"  kill from the submitter with the MOST leased workers
+                    (newest first) — the biggest offender pays, lone
+                    submitters are spared as long as possible
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Type
+
+
+class WorkerKillingPolicy:
+    name = "base"
+
+    def select(self, leased_workers: List[Any]) -> Optional[Any]:
+        raise NotImplementedError
+
+
+class RetriableLIFOPolicy(WorkerKillingPolicy):
+    """Most recently leased task worker first (reference:
+    worker_killing_policy.h:69): the newest work has the least sunk cost
+    and its retry is cheapest."""
+
+    name = "retriable_lifo"
+
+    def select(self, leased_workers: List[Any]) -> Optional[Any]:
+        if not leased_workers:
+            return None
+        ordered = sorted(
+            leased_workers,
+            key=lambda w: (w.lifetime != "task", -w.last_idle))
+        return ordered[0]
+
+
+class GroupByOwnerPolicy(WorkerKillingPolicy):
+    """Group task workers by the submitter that leased them; kill the
+    newest worker of the LARGEST group (reference:
+    worker_killing_policy_group_by_owner.h — the runaway fan-out pays
+    before well-behaved submitters lose anything)."""
+
+    name = "group_by_owner"
+
+    def select(self, leased_workers: List[Any]) -> Optional[Any]:
+        tasks = [w for w in leased_workers if w.lifetime == "task"]
+        pool = tasks or leased_workers
+        if not pool:
+            return None
+        groups: Dict[Any, List[Any]] = {}
+        for w in pool:
+            groups.setdefault(getattr(w, "lease_owner", None), []).append(w)
+        biggest = max(groups.values(),
+                      key=lambda ws: (len(ws), max(w.last_idle
+                                                   for w in ws)))
+        return max(biggest, key=lambda w: w.last_idle)
+
+
+_POLICIES: Dict[str, Type[WorkerKillingPolicy]] = {
+    RetriableLIFOPolicy.name: RetriableLIFOPolicy,
+    GroupByOwnerPolicy.name: GroupByOwnerPolicy,
+}
+
+
+def register_policy(cls: Type[WorkerKillingPolicy]) -> None:
+    """Third-party policies plug in by name (the pluggable half of C19)."""
+    _POLICIES[cls.name] = cls
+
+
+def get_policy(name: str) -> WorkerKillingPolicy:
+    cls = _POLICIES.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown oom_killer_policy {name!r}; known: "
+            f"{sorted(_POLICIES)}")
+    return cls()

@@ -94,6 +94,9 @@ class RayTpuConfig:
     # recently leased task worker (retriable-LIFO policy). <=0 disables.
     memory_usage_threshold: float = 0.95
     memory_monitor_interval_s: float = 1.0
+    # Victim-selection policy above the threshold (core/oom_policies.py):
+    # "retriable_lifo" (default) or "group_by_owner".
+    oom_killer_policy: str = "retriable_lifo"
 
     # --- chaos / testing (reference: rpc_chaos.h, asio_chaos.cc) ---
     # "method:failure_prob" comma list, e.g. "push_task:0.1,lease:0.05".
@@ -104,6 +107,9 @@ class RayTpuConfig:
     # --- TPU ---
     # Virtualize TPU count for tests (like TPU_VISIBLE_CHIPS).
     tpu_visible_chips: str = ""
+    # Durable JSONL export-event files under <session>/export_events/
+    # (reference: RAY_enable_export_api_write + export_*.proto schemas).
+    enable_export_events: bool = True
 
     def __post_init__(self) -> None:
         for f in dataclasses.fields(self):

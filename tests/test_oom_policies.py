@@ -1,0 +1,58 @@
+"""Pluggable OOM worker-killing policies (reference:
+worker_killing_policy.h:69 + worker_killing_policy_group_by_owner.h —
+SURVEY C19)."""
+
+import pytest
+
+from ray_tpu.core.oom_policies import (
+    GroupByOwnerPolicy,
+    RetriableLIFOPolicy,
+    WorkerKillingPolicy,
+    get_policy,
+    register_policy,
+)
+
+
+class _W:
+    def __init__(self, wid, lifetime, last_idle, owner=None):
+        self.wid = wid
+        self.lifetime = lifetime
+        self.last_idle = last_idle
+        self.lease_owner = owner
+
+
+def test_retriable_lifo_prefers_newest_task():
+    ws = [_W("old-task", "task", 1.0), _W("new-task", "task", 9.0),
+          _W("newest-actor", "actor", 99.0)]
+    assert RetriableLIFOPolicy().select(ws).wid == "new-task"
+    # only actors leased: newest actor dies (tasks always first)
+    ws = [_W("a1", "actor", 1.0), _W("a2", "actor", 5.0)]
+    assert RetriableLIFOPolicy().select(ws).wid == "a2"
+    assert RetriableLIFOPolicy().select([]) is None
+
+
+def test_group_by_owner_kills_biggest_offender():
+    big = [("b1", 1.0), ("b2", 2.0), ("b3", 3.0)]
+    ws = ([_W(w, "task", t, owner=("10.0.0.1", 1)) for w, t in big]
+          + [_W("lone", "task", 99.0, owner=("10.0.0.2", 2))]
+          + [_W("actor", "actor", 100.0, owner=("10.0.0.3", 3))])
+    victim = GroupByOwnerPolicy().select(ws)
+    # the 3-worker submitter pays, newest of its group first — the lone
+    # submitter's even-newer worker is spared
+    assert victim.wid == "b3"
+
+
+def test_policy_registry():
+    assert isinstance(get_policy("retriable_lifo"), RetriableLIFOPolicy)
+    assert isinstance(get_policy("group_by_owner"), GroupByOwnerPolicy)
+    with pytest.raises(ValueError):
+        get_policy("nope")
+
+    class Custom(WorkerKillingPolicy):
+        name = "custom_test"
+
+        def select(self, leased):
+            return None
+
+    register_policy(Custom)
+    assert isinstance(get_policy("custom_test"), Custom)
