@@ -133,26 +133,14 @@ def _apply_map_batches(op: _MapBatches, block: Block) -> Block:
 # Plan optimization
 # ---------------------------------------------------------------------------
 def _fuse_plan(plan: List[Any]) -> List[Any]:
-    """Fuse consecutive task-based map ops into one (reference: Data's
-    OperatorFusionRule, _internal/logical/rules/operator_fusion.py). A
-    map→map chain otherwise pays one task dispatch + one object-store
-    round trip per stage per block; fused, each block crosses the plane
-    once. Actor ops don't fuse (they pin state to a pool)."""
-    out: List[Any] = [plan[0]]
-    for op in plan[1:]:
-        prev = out[-1]
-        if (isinstance(op, _MapBatches) and isinstance(prev, _MapBatches)
-                and prev.num_cpus == op.num_cpus):
-            stages = list(prev.fused_stages or [prev])
-            fused = _MapBatches(
-                fn=None, batch_size=None, num_cpus=op.num_cpus,
-                window=min(prev.window, op.window),
-                name=f"{prev.name}->{op.name}")
-            fused.fused_stages = stages + [op]
-            out[-1] = fused
-            continue
-        out.append(op)
-    return out
+    """Plan optimization now runs through the rule framework
+    (data/planner.py — reference: _internal/logical/optimizers.py);
+    operator fusion is its first built-in rule. Kept as the executor's
+    entry point so custom rules registered via planner.register_rule
+    apply to every dataset."""
+    from ray_tpu.data.planner import optimize
+
+    return optimize(plan)
 
 
 # ---------------------------------------------------------------------------
@@ -182,11 +170,15 @@ def _map_stream(op: _MapBatches, upstream: Iterator[Any]) -> Iterator[Any]:
     def _run(block: Block, op=op) -> Block:
         return _apply_map_batches(op, block)
 
+    from ray_tpu.data.planner import effective_window
+
     remote = _run.options(num_cpus=op.num_cpus)
     inflight: "deque[Any]" = deque()
     for ref in upstream:
         inflight.append(remote.remote(ref))
-        if len(inflight) >= max(1, op.window):
+        # Backpressure policies re-evaluated per block: a full object
+        # store shrinks the window to drain mode mid-stream.
+        if len(inflight) >= effective_window(op):
             yield inflight.popleft()
     while inflight:
         yield inflight.popleft()

@@ -1,0 +1,149 @@
+"""Plan optimization rules + execution backpressure policies
+(reference: python/ray/data/_internal/logical/optimizers.py — the
+rule-based LogicalOptimizer/PhysicalOptimizer pair — and
+_internal/execution/backpressure_policy/backpressure_policy.py).
+
+Rules are pure plan→plan rewrites applied in order by the executor;
+backpressure policies bound each operator's in-flight task window at
+runtime. Both are extension points: `register_rule` /
+`register_backpressure_policy` add custom ones process-wide, and a
+Dataset can carry its own via `with_rules` (see dataset.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+class Rule:
+    """One plan rewrite (reference: logical/interfaces.Rule)."""
+
+    name = "rule"
+
+    def apply(self, plan: List[Any]) -> List[Any]:
+        raise NotImplementedError
+
+
+class OperatorFusionRule(Rule):
+    """Fuse consecutive task-based map ops into one task (reference:
+    _internal/logical/rules/operator_fusion.py). A map→map chain
+    otherwise pays one dispatch + one object-store round trip per stage
+    per block. Actor ops don't fuse (they pin state to a pool)."""
+
+    name = "operator_fusion"
+
+    def apply(self, plan: List[Any]) -> List[Any]:
+        from ray_tpu.data.dataset import _MapBatches
+
+        out: List[Any] = [plan[0]]
+        for op in plan[1:]:
+            prev = out[-1]
+            if (isinstance(op, _MapBatches)
+                    and isinstance(prev, _MapBatches)
+                    and prev.num_cpus == op.num_cpus):
+                stages = list(prev.fused_stages or [prev])
+                fused = _MapBatches(
+                    fn=None, batch_size=None, num_cpus=op.num_cpus,
+                    window=min(prev.window, op.window),
+                    name=f"{prev.name}->{op.name}")
+                fused.fused_stages = stages + [op]
+                out[-1] = fused
+                continue
+            out.append(op)
+        return out
+
+
+_RULES: List[Rule] = [OperatorFusionRule()]
+
+
+def register_rule(rule: Rule) -> None:
+    _RULES.append(rule)
+
+
+def get_rules() -> List[Rule]:
+    return list(_RULES)
+
+
+def optimize(plan: List[Any], extra_rules: Any = None) -> List[Any]:
+    for rule in list(_RULES) + list(extra_rules or []):
+        try:
+            plan = rule.apply(plan)
+        except Exception:  # noqa: BLE001 - a broken custom rule must not
+            logger.exception("plan rule %s failed; skipping", rule.name)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Backpressure policies
+# ---------------------------------------------------------------------------
+class BackpressurePolicy:
+    """Bounds an operator's in-flight task window (reference:
+    backpressure_policy.py — policies can only SHRINK concurrency)."""
+
+    name = "backpressure"
+
+    def max_inflight(self, op: Any) -> int:
+        raise NotImplementedError
+
+
+class ConcurrencyCapBackpressurePolicy(BackpressurePolicy):
+    """The operator's configured window (reference:
+    concurrency_cap_backpressure_policy.py)."""
+
+    name = "concurrency_cap"
+
+    def max_inflight(self, op: Any) -> int:
+        return max(1, getattr(op, "window", 4))
+
+
+class ObjectStoreMemoryBackpressurePolicy(BackpressurePolicy):
+    """Shrink windows while the local arena is under pressure: every
+    in-flight block pins store space, and racing ahead of a full store
+    just converts task throughput into spill churn (reference:
+    streaming_output_backpressure / reservation policies)."""
+
+    name = "object_store_memory"
+
+    def __init__(self, high_watermark: float = 0.8):
+        self.high_watermark = high_watermark
+
+    def max_inflight(self, op: Any) -> int:
+        window = max(1, getattr(op, "window", 4))
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker_or_none()
+            if w is None:
+                return window
+            stats = w.shm.stats()
+            frac = stats["bytes_in_use"] / max(1, stats["capacity"])
+        except Exception:  # noqa: BLE001
+            return window
+        if frac >= self.high_watermark:
+            return 1  # drain mode: one block in flight per operator
+        return window
+
+
+_BP_POLICIES: List[BackpressurePolicy] = [
+    ConcurrencyCapBackpressurePolicy(),
+    ObjectStoreMemoryBackpressurePolicy(),
+]
+
+
+def register_backpressure_policy(policy: BackpressurePolicy) -> None:
+    _BP_POLICIES.append(policy)
+
+
+def effective_window(op: Any) -> int:
+    """The tightest bound across policies (policies only shrink)."""
+    window = max(1, getattr(op, "window", 4))
+    for policy in _BP_POLICIES:
+        try:
+            window = min(window, max(1, policy.max_inflight(op)))
+        except Exception:  # noqa: BLE001
+            continue
+    return window
