@@ -1,0 +1,270 @@
+"""Autoscaler v2: instance-manager state machine (reference:
+python/ray/autoscaler/v2/autoscaler.py:47 + v2/instance_manager/ — the
+explicit per-instance lifecycle that replaced v1's implicit node lists).
+
+Every instance the autoscaler ever requested is a durable record walked
+through the v2 lifecycle:
+
+    QUEUED -> REQUESTED -> ALLOCATED -> RAY_RUNNING
+         -> RAY_STOPPING -> TERMINATING -> TERMINATED
+    (any state) -> ALLOCATION_FAILED / TERMINATED on provider errors
+
+The reconciler is the only writer: each tick it (1) syncs provider +
+cluster reality into the records (allocated? nodelet registered?),
+(2) computes the demand delta exactly like v1 (pending PGs/actors +
+unmet task shapes), and (3) issues provider calls for the transitions —
+so crash/restart recovery, stuck-instance timeouts, and observability
+(get_instances) all fall out of the table instead of living in ad-hoc
+lists. The v1 `Autoscaler` stays as the compact demand loop; this is
+the state-machine deployment surface."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.autoscaler import NodeProvider, _node_key
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Instance lifecycle states (reference: v2/instance_manager/common.py).
+QUEUED = "QUEUED"
+REQUESTED = "REQUESTED"
+ALLOCATED = "ALLOCATED"
+RAY_RUNNING = "RAY_RUNNING"
+RAY_STOPPING = "RAY_STOPPING"
+TERMINATING = "TERMINATING"
+TERMINATED = "TERMINATED"
+ALLOCATION_FAILED = "ALLOCATION_FAILED"
+
+_TERMINAL = (TERMINATED, ALLOCATION_FAILED)
+
+
+class Instance:
+    def __init__(self, instance_id: str, resources: Dict[str, float]):
+        self.instance_id = instance_id
+        self.resources = dict(resources)
+        self.state = QUEUED
+        self.node: Any = None          # provider handle once ALLOCATED
+        self.node_id: str = ""         # GCS node id once RAY_RUNNING
+        self.state_since = time.monotonic()
+        self.history: List[str] = [QUEUED]
+        self.error: str = ""
+
+    def set_state(self, state: str, error: str = "") -> None:
+        if state == self.state:
+            return
+        self.state = state
+        self.state_since = time.monotonic()
+        self.history.append(state)
+        if error:
+            self.error = error
+
+    def view(self) -> Dict[str, Any]:
+        return {
+            "instance_id": self.instance_id,
+            "state": self.state,
+            "resources": self.resources,
+            "node_id": self.node_id,
+            "age_in_state_s": round(
+                time.monotonic() - self.state_since, 1),
+            "history": list(self.history),
+            "error": self.error,
+        }
+
+
+class InstanceManager:
+    """The durable instance table + its transitions (reference:
+    v2/instance_manager/instance_manager.py). Thread-safe; the
+    reconciler is the only caller that mutates."""
+
+    def __init__(self):
+        self._instances: Dict[str, Instance] = {}
+        self._lock = threading.Lock()
+
+    def add(self, resources: Dict[str, float]) -> Instance:
+        inst = Instance(f"inst-{uuid.uuid4().hex[:12]}", resources)
+        with self._lock:
+            self._instances[inst.instance_id] = inst
+        return inst
+
+    def all(self) -> List[Instance]:
+        with self._lock:
+            return list(self._instances.values())
+
+    def live(self) -> List[Instance]:
+        return [i for i in self.all() if i.state not in _TERMINAL]
+
+    def views(self) -> List[Dict[str, Any]]:
+        return [i.view() for i in self.all()]
+
+
+class AutoscalerV2:
+    """Demand-driven reconciler over the instance table (reference:
+    v2/autoscaler.py:47 — sketch: sync state, compute diff, issue
+    provider calls; one loop, no callbacks)."""
+
+    def __init__(self, provider: NodeProvider, *, min_workers: int = 0,
+                 max_workers: int = 4, idle_timeout_s: float = 30.0,
+                 allocate_timeout_s: float = 120.0,
+                 interval_s: float = 2.0,
+                 default_worker_resources: Optional[Dict[str,
+                                                         float]] = None):
+        self.provider = provider
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.idle_timeout_s = idle_timeout_s
+        self.allocate_timeout_s = allocate_timeout_s
+        self.interval_s = interval_s
+        self.default_worker_resources = default_worker_resources or {
+            "CPU": 1.0}
+        self.instances = InstanceManager()
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler-v2")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.reconcile()
+            except Exception:
+                logger.exception("autoscaler v2 reconcile failed")
+            self._stop.wait(self.interval_s)
+
+    # -- the v2 core: sync -> diff -> act ------------------------------
+    def reconcile(self) -> None:
+        self._sync_reality()
+        self._launch_for_demand()
+        self._terminate_idle()
+        self._expire_stuck()
+
+    def _sync_reality(self) -> None:
+        """Walk instance records forward from what the provider and the
+        GCS actually report (reference: Reconciler.sync_from)."""
+        from ray_tpu.util import state
+
+        provider_nodes = {id(n): n for n in self.provider.nodes()}
+        try:
+            alive = {n["node_id"]: n for n in state.list_nodes()
+                     if n["alive"]}
+        except Exception:  # GCS briefly unreachable: skip this tick
+            alive = None
+        for inst in self.instances.live():
+            if inst.state == REQUESTED and inst.node is not None:
+                inst.set_state(ALLOCATED)
+            if inst.state == ALLOCATED and alive is not None:
+                nid = _node_key(inst.node)
+                if nid in alive:
+                    inst.node_id = nid
+                    inst.set_state(RAY_RUNNING)
+            if inst.state == RAY_RUNNING:
+                if inst.node is not None \
+                        and id(inst.node) not in provider_nodes:
+                    # provider lost it (preemption/crash)
+                    inst.set_state(TERMINATED,
+                                   error="provider lost instance")
+                elif alive is not None and inst.node_id \
+                        and inst.node_id not in alive:
+                    inst.set_state(TERMINATED, error="node died")
+
+    def _pending_demand(self) -> List[Dict[str, float]]:
+        """Same demand signal as v1: pending PGs + pending actors +
+        unmet task lease shapes from nodelet heartbeats."""
+        from ray_tpu.autoscaler import Autoscaler
+
+        return Autoscaler._pending_demand(self)  # type: ignore[arg-type]
+
+    def _launch_for_demand(self) -> None:
+        demand = self._pending_demand()
+        live = self.instances.live()
+        # below min_workers counts as demand
+        deficit = self.min_workers - len(live)
+        want: List[Dict[str, float]] = [
+            dict(self.default_worker_resources)] * max(0, deficit)
+        pending_capacity = [i for i in live
+                            if i.state in (QUEUED, REQUESTED, ALLOCATED)]
+        for shape in demand[len(pending_capacity):]:
+            want.append({k: float(v) for k, v in shape.items()} or
+                        dict(self.default_worker_resources))
+        for resources in want:
+            if len(self.instances.live()) >= self.max_workers:
+                break
+            inst = self.instances.add(resources)
+            inst.set_state(REQUESTED)
+            try:
+                inst.node = self.provider.create_node(resources)
+            except Exception as e:  # noqa: BLE001
+                inst.set_state(ALLOCATION_FAILED, error=repr(e))
+                logger.warning("instance %s allocation failed: %r",
+                               inst.instance_id, e)
+
+    def _terminate_idle(self) -> None:
+        from ray_tpu.util import state
+
+        try:
+            workers = state.list_workers()
+        except Exception:
+            return
+        busy_nodes = {w["node_id"] for w in workers if w.get("leased")}
+        now = time.monotonic()
+        running = [i for i in self.instances.live()
+                   if i.state == RAY_RUNNING]
+        for inst in running:
+            if len([i for i in self.instances.live()
+                    if i.state == RAY_RUNNING]) <= self.min_workers:
+                break
+            if inst.node_id in busy_nodes:
+                self._idle_since.pop(inst.instance_id, None)
+                continue
+            first = self._idle_since.setdefault(inst.instance_id, now)
+            if now - first < self.idle_timeout_s:
+                continue
+            inst.set_state(TERMINATING)
+            try:
+                self.provider.terminate_node(inst.node)
+                inst.set_state(TERMINATED)
+            except Exception as e:  # noqa: BLE001
+                inst.set_state(TERMINATED, error=repr(e))
+            self._idle_since.pop(inst.instance_id, None)
+
+    def _expire_stuck(self) -> None:
+        """An instance stuck pre-RAY_RUNNING past the allocate timeout is
+        failed + released (reference: v2 stuck-instance reconciliation)."""
+        now = time.monotonic()
+        for inst in self.instances.live():
+            if inst.state in (REQUESTED, ALLOCATED) \
+                    and now - inst.state_since > self.allocate_timeout_s:
+                if inst.node is not None:
+                    try:
+                        self.provider.terminate_node(inst.node)
+                    except Exception:  # noqa: BLE001
+                        pass
+                inst.set_state(ALLOCATION_FAILED,
+                               error="allocation timed out")
+
+    # -- observability -------------------------------------------------
+    def get_instances(self) -> List[Dict[str, Any]]:
+        return self.instances.views()
+
+    def summary(self) -> Dict[str, Any]:
+        counts: Dict[str, int] = {}
+        for inst in self.instances.all():
+            counts[inst.state] = counts.get(inst.state, 0) + 1
+        return {"instances": counts,
+                "live": len(self.instances.live()),
+                "max_workers": self.max_workers}

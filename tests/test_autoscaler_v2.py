@@ -1,0 +1,89 @@
+"""Autoscaler v2 instance-manager state machine (reference:
+autoscaler/v2/autoscaler.py:47 + v2/instance_manager/)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler_v2 import (
+    ALLOCATION_FAILED,
+    RAY_RUNNING,
+    TERMINATED,
+    AutoscalerV2,
+)
+
+
+def test_instance_walks_lifecycle_and_idle_terminates():
+    from ray_tpu.autoscaler import LocalNodeProvider
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1,
+                                      "object_store_memory": 128 * 2**20})
+    ray_tpu.init(address=cluster.address)
+    provider = LocalNodeProvider(cluster.head_node, {"CPU": 1.0})
+    scaler = AutoscalerV2(provider, min_workers=0, max_workers=2,
+                          idle_timeout_s=2.0, interval_s=0.2)
+    try:
+        # demand: an actor needing a resource no current node has
+        @ray_tpu.remote(resources={"v2only": 1.0})
+        class Pinned:
+            def ping(self):
+                return 1
+
+        provider.default_resources = {"CPU": 1.0, "v2only": 1.0}
+        a = Pinned.remote()
+        scaler.start()
+        # the reconciler launches an instance and walks it to RAY_RUNNING
+        assert ray_tpu.get(a.ping.remote(), timeout=90) == 1
+        deadline = time.monotonic() + 30
+        running = []
+        while time.monotonic() < deadline:
+            running = [i for i in scaler.get_instances()
+                       if i["state"] == RAY_RUNNING]
+            if running:
+                break
+            time.sleep(0.2)
+        assert running, scaler.get_instances()
+        hist = running[0]["history"]
+        assert hist[:2] == ["QUEUED", "REQUESTED"]
+        assert "ALLOCATED" in hist and hist[-1] == "RAY_RUNNING"
+
+        # release the actor; the idle node terminates through the FSM
+        ray_tpu.kill(a)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            views = scaler.get_instances()
+            if any(v["state"] == TERMINATED and "RAY_RUNNING"
+                   in v["history"] for v in views):
+                break
+            time.sleep(0.3)
+        assert any(v["state"] == TERMINATED for v in
+                   scaler.get_instances()), scaler.get_instances()
+    finally:
+        scaler.stop()
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_allocation_failure_is_terminal(ray_start_regular):
+    from ray_tpu.autoscaler import NodeProvider
+
+    class BrokenProvider(NodeProvider):
+        def create_node(self, resources):
+            raise RuntimeError("quota exceeded")
+
+        def terminate_node(self, node):
+            pass
+
+        def nodes(self):
+            return []
+
+    scaler = AutoscalerV2(BrokenProvider(), min_workers=1, max_workers=2,
+                          interval_s=0.1)
+    scaler.reconcile()
+    views = scaler.get_instances()
+    assert views and views[0]["state"] == ALLOCATION_FAILED
+    assert "quota" in views[0]["error"]
+    # terminal instances never consume the live budget
+    assert scaler.summary()["live"] == 0
