@@ -1,0 +1,127 @@
+"""Worker cgroup memory isolation (reference: src/ray/common/cgroup/ —
+per-worker cgroups so a runaway worker is CONTAINED by the kernel, not
+just killed after the fact by the memory monitor).
+
+Supports cgroup v1 (memory controller hierarchy) and v2 (unified) and
+degrades to a no-op where the hierarchy isn't writable (unprivileged
+containers) — availability is probed once, and every operation is
+best-effort: isolation must never break worker spawn.
+
+The nodelet applies a limit at LEASE time when the lease carries a
+"memory" resource, and relaxes it when the worker returns to the pool.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_V1_MEM = "/sys/fs/cgroup/memory"
+_V2_ROOT = "/sys/fs/cgroup"
+
+
+class CgroupManager:
+    """Per-session cgroup tree: <controller>/ray_tpu_<tag>/<worker>."""
+
+    def __init__(self, tag: str):
+        self.tag = f"ray_tpu_{tag}"
+        self.mode = self._detect()
+        self.base: Optional[str] = None
+        if self.mode:
+            root = _V1_MEM if self.mode == "v1" else _V2_ROOT
+            base = os.path.join(root, self.tag)
+            try:
+                os.makedirs(base, exist_ok=True)
+                self.base = base
+            except OSError:
+                self.mode = None
+        if self.mode:
+            logger.info("worker cgroup isolation active (%s) at %s",
+                        self.mode, self.base)
+
+    @staticmethod
+    def _detect() -> Optional[str]:
+        try:
+            if os.path.isdir(_V1_MEM) and os.access(_V1_MEM, os.W_OK):
+                probe = os.path.join(_V1_MEM, ".ray_tpu_probe")
+                os.makedirs(probe, exist_ok=True)
+                os.rmdir(probe)
+                return "v1"
+        except OSError:
+            pass
+        try:
+            controllers = os.path.join(_V2_ROOT, "cgroup.controllers")
+            if os.path.exists(controllers) and "memory" in open(
+                    controllers).read():
+                probe = os.path.join(_V2_ROOT, ".ray_tpu_probe")
+                os.makedirs(probe, exist_ok=True)
+                os.rmdir(probe)
+                return "v2"
+        except OSError:
+            pass
+        return None
+
+    @property
+    def available(self) -> bool:
+        return self.mode is not None
+
+    def _worker_dir(self, worker_id: str) -> Optional[str]:
+        if self.base is None:
+            return None
+        path = os.path.join(self.base, worker_id)
+        try:
+            os.makedirs(path, exist_ok=True)
+            return path
+        except OSError:
+            return None
+
+    def limit_worker(self, worker_id: str, pid: int,
+                     memory_bytes: int) -> bool:
+        """Place pid in the worker's cgroup with a hard memory limit.
+        Returns True when the kernel actually holds the limit."""
+        path = self._worker_dir(worker_id)
+        if path is None:
+            return False
+        limit_file = ("memory.limit_in_bytes" if self.mode == "v1"
+                      else "memory.max")
+        try:
+            with open(os.path.join(path, limit_file), "w") as f:
+                f.write(str(int(memory_bytes)))
+            with open(os.path.join(path, "cgroup.procs"), "w") as f:
+                f.write(str(pid))
+            return True
+        except OSError as e:
+            logger.debug("cgroup limit failed for %s: %r", worker_id, e)
+            return False
+
+    def relax_worker(self, worker_id: str) -> None:
+        """Lift the limit when the worker returns to the shared pool."""
+        path = self._worker_dir(worker_id)
+        if path is None:
+            return
+        limit_file = ("memory.limit_in_bytes" if self.mode == "v1"
+                      else "memory.max")
+        try:
+            with open(os.path.join(path, limit_file), "w") as f:
+                f.write("-1" if self.mode == "v1" else "max")
+        except OSError:
+            pass
+
+    def cleanup(self) -> None:
+        if self.base is None:
+            return
+        try:
+            for name in os.listdir(self.base):
+                sub = os.path.join(self.base, name)
+                if os.path.isdir(sub):
+                    try:
+                        os.rmdir(sub)
+                    except OSError:
+                        pass
+            os.rmdir(self.base)
+        except OSError:
+            pass

@@ -224,6 +224,13 @@ class Nodelet:
         self._zygote_lock = threading.Lock()
         # (last observed log-lease value, local monotonic time first seen)
         self._log_lease_seen: Tuple[Optional[bytes], float] = (None, 0.0)
+        # Kernel-level worker memory containment (reference:
+        # common/cgroup/): applied at lease time for leases that carry a
+        # "memory" resource; no-op where the hierarchy isn't writable.
+        from ray_tpu._private.cgroups import CgroupManager
+
+        self._cgroups = (CgroupManager(self.node_id.hex()[:8])
+                         if get_config().enable_worker_cgroups else None)
         # Versioned resource view (ray_syncer analog): bumped on every
         # availability/demand change, pushed by _resource_sync_loop.
         # The Event exists from construction so bumps before the sync
@@ -664,6 +671,11 @@ class Nodelet:
                 worker.lifetime = lifetime
                 worker.lease_owner = tuple(owner) if owner else None
                 worker.resources = req
+                mem = float(resources.get("memory", 0) or 0)
+                if mem > 0 and self._cgroups is not None                         and self._cgroups.available:
+                    worker.cgroup_limited = self._cgroups.limit_worker(
+                        worker.worker_id.hex()[:12], worker.proc.pid,
+                        int(mem))
                 worker.pg_bundle = pg_bundle
                 worker.tpu_chips = chips if num_tpus >= 1 else []
                 return {
@@ -725,6 +737,9 @@ class Nodelet:
             worker.tpu_chips = []
         worker.leased = False
         worker.last_idle = time.monotonic()
+        if getattr(worker, "cgroup_limited", False)                 and self._cgroups is not None:
+            self._cgroups.relax_worker(worker.worker_id.hex()[:12])
+            worker.cgroup_limited = False
         self._wake_lease_waiters()
         if kill and worker.proc.poll() is None:
             worker.proc.terminate()

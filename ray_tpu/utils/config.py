@@ -97,6 +97,10 @@ class RayTpuConfig:
     # Victim-selection policy above the threshold (core/oom_policies.py):
     # "retriable_lifo" (default) or "group_by_owner".
     oom_killer_policy: str = "retriable_lifo"
+    # Kernel cgroup memory containment for leases carrying a "memory"
+    # resource (reference: common/cgroup/); auto-disables where the
+    # cgroup hierarchy isn't writable.
+    enable_worker_cgroups: bool = True
 
     # --- chaos / testing (reference: rpc_chaos.h, asio_chaos.cc) ---
     # "method:failure_prob" comma list, e.g. "push_task:0.1,lease:0.05".

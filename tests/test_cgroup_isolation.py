@@ -1,0 +1,62 @@
+"""Worker cgroup memory containment (reference: src/ray/common/cgroup/
+— kernel-enforced limits per worker, not just monitor-kills)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.cgroups import CgroupManager
+
+_available = CgroupManager("probe_test").available
+needs_cgroups = pytest.mark.skipif(
+    not _available, reason="cgroup hierarchy not writable here")
+
+
+@needs_cgroups
+def test_manager_limits_and_relaxes():
+    mgr = CgroupManager("unit_test")
+    assert mgr.available
+    try:
+        pid = os.getpid()
+        assert mgr.limit_worker("w1", pid, 512 * 1024 * 1024)
+        wdir = os.path.join(mgr.base, "w1")
+        limit_file = ("memory.limit_in_bytes" if mgr.mode == "v1"
+                      else "memory.max")
+        limit = open(os.path.join(wdir, limit_file)).read().strip()
+        assert int(limit) >= 512 * 1024 * 1024  # kernel rounds to pages
+        procs = open(os.path.join(wdir, "cgroup.procs")).read().split()
+        assert str(pid) in procs
+        mgr.relax_worker("w1")
+        relaxed = open(os.path.join(wdir, limit_file)).read().strip()
+        assert relaxed in ("max",) or int(relaxed) > 2**60
+        # move ourselves back to the root group before cleanup
+        root_procs = os.path.join(os.path.dirname(mgr.base),
+                                  "cgroup.procs")
+        with open(root_procs, "w") as f:
+            f.write(str(pid))
+    finally:
+        mgr.cleanup()
+
+
+@needs_cgroups
+def test_memory_lease_is_kernel_contained(ray_start_regular):
+    """A task leased with a memory resource runs inside a limited cgroup;
+    allocating far past the limit dies by kernel OOM and surfaces as a
+    worker death, while a within-limit task succeeds."""
+
+    @ray_tpu.remote(memory=256 * 1024 * 1024)
+    def contained(mb):
+        buf = np.ones(mb * 1024 * 1024, np.uint8)
+        buf[::4096] = 2  # touch the pages
+        return int(buf[0]) + int(buf[-1])
+
+    # comfortably inside the limit
+    assert ray_tpu.get(contained.remote(32), timeout=120) == 3
+
+    # far past the limit: the kernel kills the worker; the task errors
+    # (after retries) instead of dragging the whole node down
+    with pytest.raises(Exception):
+        ray_tpu.get(contained.options(max_retries=0).remote(2048),
+                    timeout=180)
