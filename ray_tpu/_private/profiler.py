@@ -1,0 +1,214 @@
+"""In-process sampling CPU profiler and heap profiler for workers.
+
+Reference counterpart: the dashboard reporter agent's profiling endpoints
+(python/ray/dashboard/modules/reporter/reporter_agent.py — py-spy
+record → flamegraph, memray attach → heap report). TPU-native take: every
+worker is CPython we control, so CPU sampling rides sys._current_frames
+in-process — no ptrace capability needed (py-spy requires SYS_PTRACE,
+which containers routinely deny) — and heap profiling rides tracemalloc.
+The output formats match the reference's spirit: folded stacks (the
+flamegraph interchange format) and a top-allocations table.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+MAX_DURATION_S = 120.0
+MAX_STACK_DEPTH = 64
+
+
+def sample_folded(duration_s: float = 5.0, hz: float = 99.0,
+                  ) -> Dict[str, Any]:
+    """Sample all threads' stacks for duration_s at hz; returns
+    {"folded": {"thread;frame1;frame2": count}, "samples": N, ...}.
+
+    Runs IN the profiled process (call via the worker's cpu_profile RPC).
+    The sampling loop skips its own thread. Frame syntax matches folded
+    flamegraph lines: outermost caller first, ';'-separated.
+    """
+    duration_s = min(float(duration_s), MAX_DURATION_S)
+    hz = max(1.0, min(float(hz), 1000.0))
+    period = 1.0 / hz
+    folded: Dict[str, int] = {}
+    own = threading.get_ident()
+    samples = 0
+    t0 = time.monotonic()
+    end = t0 + duration_s
+    while True:
+        now = time.monotonic()
+        if now >= end:
+            break
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in sys._current_frames().items():
+            if tid == own:
+                continue
+            stack: List[str] = []
+            f = frame
+            while f is not None and len(stack) < MAX_STACK_DEPTH:
+                code = f.f_code
+                stack.append("%s (%s:%d)" % (
+                    code.co_name,
+                    code.co_filename.rsplit("/", 1)[-1],
+                    f.f_lineno))
+                f = f.f_back
+            stack.append("thread:" + names.get(tid, str(tid)))
+            key = ";".join(reversed(stack))
+            folded[key] = folded.get(key, 0) + 1
+        del frame  # don't pin the sampled frame graph past the tick
+        samples += 1
+        time.sleep(period)
+    return {
+        "folded": folded,
+        "samples": samples,
+        "duration_s": round(time.monotonic() - t0, 3),
+        "hz": hz,
+        "pid": __import__("os").getpid(),
+    }
+
+
+def heap_snapshot(duration_s: float = 3.0, top: int = 50,
+                  ) -> Dict[str, Any]:
+    """tracemalloc-backed allocation profile: track for duration_s, report
+    the top allocation sites live at the end plus the biggest growers over
+    the window (the memray-report shape: where is the memory, who grew)."""
+    import tracemalloc
+
+    duration_s = min(float(duration_s), MAX_DURATION_S)
+    started_here = not tracemalloc.is_tracing()
+    if started_here:
+        tracemalloc.start(16)
+    try:
+        before = tracemalloc.take_snapshot()
+        time.sleep(duration_s)
+        after = tracemalloc.take_snapshot()
+        current, peak = tracemalloc.get_traced_memory()
+
+        def _rows(stats, n):
+            rows = []
+            for st in stats[:n]:
+                frames = [f"{fr.filename.rsplit('/', 1)[-1]}:{fr.lineno}"
+                          for fr in st.traceback]
+                rows.append({
+                    "site": " < ".join(frames[:8]),
+                    "size_kb": round(st.size / 1024, 1),
+                    "count": st.count,
+                    "grew_kb": round(
+                        getattr(st, "size_diff", 0) / 1024, 1),
+                })
+            return rows
+
+        return {
+            "top_live": _rows(after.statistics("traceback"), top),
+            "top_growers": _rows(
+                after.compare_to(before, "traceback"), top),
+            "traced_current_kb": round(current / 1024, 1),
+            "traced_peak_kb": round(peak / 1024, 1),
+            "window_s": duration_s,
+        }
+    finally:
+        if started_here:
+            tracemalloc.stop()
+
+
+# ---------------------------------------------------------------------------
+# Flamegraph rendering: folded stacks → one self-contained HTML string.
+# ---------------------------------------------------------------------------
+
+def _build_trie(folded: Dict[str, int]):
+    root: Dict[str, Any] = {"n": "all", "v": 0, "c": {}}
+    for key, count in folded.items():
+        root["v"] += count
+        node = root
+        for frame in key.split(";"):
+            child = node["c"].get(frame)
+            if child is None:
+                child = node["c"][frame] = {"n": frame, "v": 0, "c": {}}
+            child["v"] += count
+            node = child
+    return root
+
+
+def _trie_json(node) -> Dict[str, Any]:
+    return {"name": node["n"], "value": node["v"],
+            "children": [_trie_json(c) for c in
+                         sorted(node["c"].values(),
+                                key=lambda x: -x["v"])]}
+
+
+_FLAME_HTML = """<!doctype html><meta charset="utf-8">
+<title>ray_tpu cpu profile</title>
+<style>
+ body{font:12px system-ui,sans-serif;margin:12px;background:#fafafa}
+ #g{position:relative}
+ .fr{position:absolute;height:17px;line-height:17px;overflow:hidden;
+     white-space:nowrap;border-radius:2px;cursor:pointer;
+     padding-left:3px;box-sizing:border-box;font-size:11px}
+ .fr:hover{filter:brightness(.85)}
+ #crumb{margin:8px 0;color:#555}
+</style>
+<h3>CPU profile — %(samples)s samples @ %(hz)s Hz over %(dur)ss</h3>
+<div id="crumb">click a frame to zoom; click the root to reset</div>
+<div id="g"></div>
+<script>
+const DATA = %(data)s;
+const g = document.getElementById("g");
+function color(name){let h=0;for(const ch of name)h=(h*31+ch.charCodeAt(0))|0;
+ return `hsl(${20+(h>>>0)%%35} ${60+(h>>>8)%%30}%% ${62+(h>>>16)%%14}%%)`;}
+function render(root){
+ g.innerHTML=""; const W=g.clientWidth||960; let maxD=0;
+ (function depth(n,d){maxD=Math.max(maxD,d);
+   n.children.forEach(c=>depth(c,d+1));})(root,0);
+ g.style.height=(maxD+1)*18+"px";
+ (function place(n,x,w,d){
+   if(w<1) return;
+   const e=document.createElement("div"); e.className="fr";
+   e.style.left=x+"px"; e.style.width=Math.max(1,w-1)+"px";
+   e.style.top=d*18+"px"; e.style.background=color(n.name);
+   e.textContent=w>40?n.name:""; e.title=
+     `${n.name}\\n${n.value} samples (${(100*n.value/DATA.value).toFixed(1)}%%)`;
+   e.onclick=()=>render(n===root&&n!==DATA?DATA:n);
+   g.appendChild(e);
+   let cx=x;
+   for(const c of n.children){const cw=w*c.value/n.value;place(c,cx,cw,d+1);cx+=cw;}
+ })(root,0,W,0);
+}
+render(DATA); addEventListener("resize",()=>render(DATA));
+</script>"""
+
+
+def flamegraph_html(profile: Dict[str, Any]) -> str:
+    """Render a sample_folded() result (or a merge of several) as a
+    self-contained zoomable flamegraph page."""
+    import json
+
+    trie = _trie_json(_build_trie(profile.get("folded") or {}))
+    return _FLAME_HTML % {
+        "samples": profile.get("samples", "?"),
+        "hz": profile.get("hz", "?"),
+        "dur": profile.get("duration_s", "?"),
+        "data": json.dumps(trie),
+    }
+
+
+def merge_folded(profiles) -> Dict[str, Any]:
+    """Merge several sample_folded() results (e.g. every worker on a node)
+    into one; worker labels become root frames."""
+    folded: Dict[str, int] = {}
+    samples = 0
+    dur = 0.0
+    hz: Any = "?"
+    for label, prof in profiles:
+        if not isinstance(prof, dict) or "folded" not in prof:
+            continue
+        samples += prof.get("samples", 0)
+        dur = max(dur, prof.get("duration_s", 0.0))
+        hz = prof.get("hz", hz)
+        for key, count in prof["folded"].items():
+            lk = f"{label};{key}"
+            folded[lk] = folded.get(lk, 0) + count
+    return {"folded": folded, "samples": samples,
+            "duration_s": dur, "hz": hz}

@@ -129,13 +129,34 @@ class TaskSpec:
         """Lease-reuse key (reference: SchedulingKey in
         normal_task_submitter.h:44 — resource shape + runtime env + strategy).
         The full strategy identity matters: PG bundles with different indexes
-        or different affinity nodes must not share a lease pool."""
-        env_key = repr(sorted((self.runtime_env or {}).items()))
-        sel_key = repr(sorted((self.label_selector or {}).items()))
-        return (self.resources.key(), env_key,
-                repr(self.scheduling_strategy), sel_key)
+        or different affinity nodes must not share a lease pool.
+
+        Cached per spec (submit + every retry requeue recompute it); the
+        cache lives outside the field list so __reduce__ never ships it."""
+        key = self.__dict__.get("_sched_key")
+        if key is None:
+            env_key = repr(sorted((self.runtime_env or {}).items()))
+            sel_key = repr(sorted((self.label_selector or {}).items()))
+            key = (self.resources.key(), env_key,
+                   repr(self.scheduling_strategy), sel_key)
+            self.__dict__["_sched_key"] = key
+        return key
 
     def return_ids(self) -> List[ObjectID]:
         return [
             ObjectID.for_task_return(self.task_id, i) for i in range(self.num_returns)
         ]
+
+    def __reduce__(self):
+        # Positional field tuple instead of the dataclass-default dict
+        # pickle: a spec crosses the wire on every task push, and the
+        # default form re-serializes all 22 field-name strings per spec.
+        return (_spec_from_tuple,
+                (tuple(getattr(self, f) for f in _SPEC_FIELD_NAMES),))
+
+
+_SPEC_FIELD_NAMES = tuple(f.name for f in dataclasses.fields(TaskSpec))
+
+
+def _spec_from_tuple(values: Tuple) -> TaskSpec:
+    return TaskSpec(*values)

@@ -470,6 +470,14 @@ class ActorSubmitter:
                             self._on_reply_done(s, r, a, f))
                     if not batch:
                         continue
+                # Actor specs cross as ser_spec bytes (normal tasks ship
+                # TaskSpec objects — one frame pickle, shared memo). Actor
+                # frames may sit decoded in long-lived receiver state (fast
+                # lane loop vars, channel-loop kwargs); opaque bytes keep
+                # arg ObjectRefs/buffers from materializing borrows or
+                # pinning receive frames beyond task execution — switching
+                # them to objects leaked a device-object borrow in the
+                # channel-DAG suite.
                 if len(batch) == 1:
                     spec, retries, attempt = batch[0]
                     fut = await client.start_call("push_actor_task",
@@ -647,6 +655,9 @@ class Worker:
         # pressure — put_shm_or_spill moves the LRU victim to disk first.
         self.shm.set_auto_evict(False)
         self.ref_counter = ReferenceCounter(on_zero=self._on_owned_ref_zero)
+        # True once the node's spill dir has been observed to exist —
+        # gates the per-ref spill unlink (see _on_owned_ref_zero).
+        self._spill_dir_seen = False
         self.task_manager = TaskManager(self._store_task_result)
         self.server = RpcServer()
         self.address: Optional[Tuple[str, int]] = None
@@ -826,6 +837,8 @@ class Worker:
         s.register("fast_lane_info", self._rpc_fast_lane_info)
         s.register("dag_method_info", self._rpc_dag_method_info)
         s.register("dump_stacks", self._rpc_dump_stacks)
+        s.register("cpu_profile", self._rpc_cpu_profile)
+        s.register("heap_profile", self._rpc_heap_profile)
         s.register("device_object_fetch", self._rpc_device_object_fetch)
         s.register("device_object_fetch_shm", self._rpc_device_object_fetch_shm)
         s.register("device_object_mesh_send", self._rpc_device_object_mesh_send)
@@ -849,6 +862,27 @@ class Worker:
             label = f"{names.get(ident, '?')} ({ident})"
             stacks[label] = "".join(traceback.format_stack(frame))
         return {"pid": os.getpid(), "stacks": stacks}
+
+    async def _rpc_cpu_profile(self, duration: float = 5.0,
+                               hz: float = 99.0) -> Dict[str, Any]:
+        """Sampling CPU profile of this worker → folded stacks (reference:
+        the reporter agent's py-spy record/flamegraph endpoint; see
+        _private/profiler.py for why sampling is in-process here). Runs on
+        a dedicated thread so task-executor threads keep executing — they
+        are exactly what the caller wants to observe."""
+        from ray_tpu._private import profiler
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, profiler.sample_folded, duration, hz)
+
+    async def _rpc_heap_profile(self, duration: float = 3.0,
+                                top: int = 50) -> Dict[str, Any]:
+        """tracemalloc allocation profile (reference: the reporter agent's
+        memray attach endpoint)."""
+        from ray_tpu._private import profiler
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, profiler.heap_snapshot, duration, top)
 
     async def _rpc_dag_channel_push(self, key: str, payload) -> Dict[str, Any]:
         from ray_tpu.experimental.channel import rpc_channel
@@ -996,12 +1030,28 @@ class Worker:
             from ray_tpu.experimental import device_objects as devobj
 
             devobj.on_owner_ref_zero(self, object_id)
-        self.memory_store.delete(object_id)
+        val = self.memory_store.pop(object_id)
         self.task_manager.drop_lineage(object_id)
+        if val is not None and not isinstance(val, ShmMarker):
+            # Inline value: it never touched the arena and inline objects
+            # are never spilled — done. (Small task returns dominate ref
+            # churn; the arena probe + spill unlink are syscalls.)
+            del val
+            return
         try:
             self.shm.delete(object_id)
         except Exception:
             pass
+        # No spill dir on this node → nothing was ever spilled here; skip
+        # the unlink + path-join. The existence check is a fresh stat
+        # every time (a timed negative cache would let an object spilled
+        # and freed inside the window leak its file); once the dir
+        # exists, that fact is cached forever — dirs are never removed
+        # within a session.
+        if not self._spill_dir_seen:
+            if not os.path.isdir(self.spill_dir):
+                return
+            self._spill_dir_seen = True
         from ray_tpu.core.object_store import spill_delete
 
         spill_delete(self.spill_dir, object_id)
@@ -1609,7 +1659,8 @@ class Worker:
             args=p_args,
             kwargs=p_kwargs,
             num_returns=num_returns,
-            resources=ResourceSet(resources or {"CPU": 1.0}),
+            resources=(resources if isinstance(resources, ResourceSet)
+                       else ResourceSet(resources or {"CPU": 1.0})),
             scheduling_strategy=scheduling_strategy or DefaultStrategy(),
             max_retries=cfg.task_max_retries if max_retries is None else max_retries,
             retry_exceptions=retry_exceptions,
@@ -1825,7 +1876,7 @@ class Worker:
             self.task_manager.mark_inflight(spec.task_id, addr)
         try:
             reply = await client.call(
-                "push_task_batch", specs=[ser_spec(s) for s in specs],
+                "push_task_batch", specs=specs,
                 timeout=86400.0)
             replies = reply["replies"]
         except (ConnectionLost, RemoteError, asyncio.TimeoutError, OSError) as e:
@@ -1858,7 +1909,7 @@ class Worker:
         unusable (connection lost) so the caller drops the lease."""
         self.task_manager.mark_inflight(spec.task_id, addr)
         try:
-            reply = await client.call("push_task", spec=ser_spec(spec),
+            reply = await client.call("push_task", spec=spec,
                                       timeout=86400.0)
         except (ConnectionLost, RemoteError, asyncio.TimeoutError, OSError) as e:
             retry_spec = self.task_manager.fail_or_retry(spec.task_id)
@@ -2137,13 +2188,14 @@ class Worker:
     # ------------------------------------------------------------------
     # Execution side (runs in worker processes)
     # ------------------------------------------------------------------
-    async def _rpc_push_task(self, spec: bytes) -> Dict[str, Any]:
-        task_spec = deser_spec(spec)
+    async def _rpc_push_task(self, spec) -> Dict[str, Any]:
+        if isinstance(spec, (bytes, bytearray, memoryview)):
+            spec = deser_spec(spec)
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            self._task_executor, self._execute_task_sync, task_spec)
+            self._task_executor, self._execute_task_sync, spec)
 
-    async def _rpc_push_task_batch(self, specs: List[bytes]) -> Dict[str, Any]:
+    async def _rpc_push_task_batch(self, specs: List[TaskSpec]) -> Dict[str, Any]:
         """Execute a batch of normal tasks (one RPC frame per submitter
         pipeline window). The whole batch runs in ONE executor hop — a
         thread handoff per task would dominate short tasks; cross-batch
@@ -2152,7 +2204,9 @@ class Worker:
         loop = asyncio.get_running_loop()
 
         def run_batch():
-            return [self._execute_task_sync(deser_spec(s)) for s in specs]
+            return [self._execute_task_sync(
+                deser_spec(s) if isinstance(s, bytes) else s)
+                for s in specs]
 
         replies = await loop.run_in_executor(self._task_executor, run_batch)
         return {"replies": replies}
@@ -2259,8 +2313,9 @@ class Worker:
             except Exception:
                 pass
 
-    def _fast_lane_execute(self, spec_bytes: bytes) -> Dict[str, Any]:
-        spec = deser_spec(spec_bytes)
+    def _fast_lane_execute(self, spec) -> Dict[str, Any]:
+        if isinstance(spec, (bytes, bytearray, memoryview)):
+            spec = deser_spec(spec)  # legacy frame shape
         if spec.actor_method_name == "__dag_channel_loop__":
             # Never on the fast lane: the loop replies only at teardown and
             # this connection is strictly sequential (the submitter routes
@@ -2360,11 +2415,12 @@ class Worker:
                 except Exception:
                     pass
 
-    async def _rpc_push_actor_task_batch(self, specs: List[bytes]) -> Dict[str, Any]:
+    async def _rpc_push_actor_task_batch(self, specs: List[TaskSpec]) -> Dict[str, Any]:
         """Execute a batch of actor tasks. Runs of consecutive sync methods
         collapse into one executor hop (ordering preserved — same thread, in
         order); async methods interleave via gather as before."""
-        decoded = [deser_spec(s) for s in specs]
+        decoded = [deser_spec(s) if isinstance(s, bytes) else s
+                   for s in specs]
         loop = asyncio.get_running_loop()
 
         def is_batchable_sync(spec: TaskSpec):
@@ -2417,16 +2473,19 @@ class Worker:
                 replies.extend(res)
         return {"replies": replies}
 
-    async def _rpc_push_actor_task(self, spec: bytes) -> Dict[str, Any]:
+    async def _rpc_push_actor_task(self, spec: TaskSpec) -> Dict[str, Any]:
         if os.environ.get("RAY_TPU_PUSH_TRACE"):
             t0 = time.perf_counter_ns()
-            task_spec = deser_spec(spec)
+            if isinstance(spec, (bytes, bytearray, memoryview)):
+                spec = deser_spec(spec)
             t1 = time.perf_counter_ns()
-            reply = await self._rpc_push_actor_task_decoded(task_spec)
+            reply = await self._rpc_push_actor_task_decoded(spec)
             t2 = time.perf_counter_ns()
             reply["_trace"] = {"entry": t0, "decoded": t1, "done": t2}
             return reply
-        return await self._rpc_push_actor_task_decoded(deser_spec(spec))
+        if isinstance(spec, (bytes, bytearray, memoryview)):
+            spec = deser_spec(spec)
+        return await self._rpc_push_actor_task_decoded(spec)
 
     async def _rpc_push_actor_task_decoded(
             self, task_spec: TaskSpec) -> Dict[str, Any]:

@@ -1,0 +1,391 @@
+"""Generic cloud-VM NodeProvider: EC2/GCE wire shapes + ssh/docker
+bootstrap (reference: autoscaler/_private/aws/node_provider.py,
+autoscaler/_private/gcp/node_provider.py, and NodeUpdater's
+setup → start flow in autoscaler/_private/updater.py).
+
+Redesigned around one lifecycle instead of per-cloud providers: a
+`CloudVMApi` turns (count, config) into instance records and a
+`CloudVMProvider` owns the state machine
+
+    REQUESTED → (api poll) RUNNING-with-ip → (command runner)
+    bootstrapped nodelet → node visible to the autoscaler
+
+Cloud specifics live in api classes that only BUILD and PARSE the wire
+payloads:
+- `Ec2Api` — EC2 query API actions (RunInstances / DescribeInstances /
+  TerminateInstances), the shapes aws/node_provider.py drives via boto3.
+- `GceApi` — GCE instances REST (insert / list / delete), the shapes
+  gcp/node_provider.py drives via googleapiclient.
+Both refuse to run without an injected endpoint/session: this build has
+zero egress, so the tested contract is the payloads (the fake control
+planes in tests/test_cloud_vm_provider.py echo realistic responses).
+- `FakeVMApi` — in-memory control plane that also spawns nothing: it is
+  the provider-level fake (the TPU pod provider owns the
+  spawns-real-nodelets fake).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler import NodeProvider
+from ray_tpu.command_runner import CommandRunner, make_runner
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+REQUESTED = "REQUESTED"
+RUNNING = "RUNNING"
+BOOTSTRAPPING = "BOOTSTRAPPING"
+BOOTSTRAPPED = "BOOTSTRAPPED"
+TERMINATED = "TERMINATED"
+FAILED = "FAILED"
+
+
+@dataclasses.dataclass
+class VMRecord:
+    instance_id: str
+    state: str = REQUESTED
+    ip: str = ""
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
+    error: str = ""
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+
+class CloudVMApi:
+    """Minimal control-plane surface the provider needs."""
+
+    def request_instances(self, count: int) -> List[str]:
+        raise NotImplementedError
+
+    def describe_instances(self, ids: List[str]) -> List[VMRecord]:
+        raise NotImplementedError
+
+    def terminate_instances(self, ids: List[str]) -> None:
+        raise NotImplementedError
+
+
+class Ec2Api(CloudVMApi):
+    """EC2 query-API payloads (reference: aws/node_provider.py
+    create_node/_get_cached_node/terminate_node via boto3; the wire
+    actions underneath are these)."""
+
+    def __init__(self, *, image_id: str, instance_type: str,
+                 subnet_id: str = "", key_name: str = "",
+                 tags: Optional[Dict[str, str]] = None,
+                 request_fn: Optional[Callable[[Dict[str, Any]],
+                                               Dict[str, Any]]] = None):
+        if request_fn is None:
+            raise RuntimeError(
+                "Ec2Api needs an injected request_fn (signed-request "
+                "session): this build has no network egress. The payload "
+                "construction below is the tested contract.")
+        self.image_id = image_id
+        self.instance_type = instance_type
+        self.subnet_id = subnet_id
+        self.key_name = key_name
+        self.tags = dict(tags or {})
+        self._request = request_fn
+
+    def request_instances(self, count: int) -> List[str]:
+        params: Dict[str, Any] = {
+            "Action": "RunInstances",
+            "ImageId": self.image_id,
+            "InstanceType": self.instance_type,
+            "MinCount": count,
+            "MaxCount": count,
+        }
+        if self.subnet_id:
+            params["SubnetId"] = self.subnet_id
+        if self.key_name:
+            params["KeyName"] = self.key_name
+        for i, (k, v) in enumerate(sorted(self.tags.items()), 1):
+            params[f"TagSpecification.1.ResourceType"] = "instance"
+            params[f"TagSpecification.1.Tag.{i}.Key"] = k
+            params[f"TagSpecification.1.Tag.{i}.Value"] = v
+        reply = self._request(params)
+        return [inst["InstanceId"]
+                for inst in reply.get("Instances", [])]
+
+    _EC2_STATE = {"pending": REQUESTED, "running": RUNNING,
+                  "shutting-down": TERMINATED, "terminated": TERMINATED,
+                  "stopping": TERMINATED, "stopped": TERMINATED}
+
+    def describe_instances(self, ids: List[str]) -> List[VMRecord]:
+        params: Dict[str, Any] = {"Action": "DescribeInstances"}
+        for i, iid in enumerate(ids, 1):
+            params[f"InstanceId.{i}"] = iid
+        reply = self._request(params)
+        out = []
+        for res in reply.get("Reservations", []):
+            for inst in res.get("Instances", []):
+                out.append(VMRecord(
+                    instance_id=inst["InstanceId"],
+                    state=self._EC2_STATE.get(
+                        inst.get("State", {}).get("Name", "pending"),
+                        REQUESTED),
+                    ip=inst.get("PrivateIpAddress", "")))
+        return out
+
+    def terminate_instances(self, ids: List[str]) -> None:
+        params: Dict[str, Any] = {"Action": "TerminateInstances"}
+        for i, iid in enumerate(ids, 1):
+            params[f"InstanceId.{i}"] = iid
+        self._request(params)
+
+
+class GceApi(CloudVMApi):
+    """GCE instances REST payloads (reference: gcp/node_provider.py +
+    gcp/config.py — insert/list/delete under
+    compute/v1/projects/{p}/zones/{z}/instances)."""
+
+    def __init__(self, *, project: str, zone: str, machine_type: str,
+                 source_image: str, network: str = "default",
+                 labels: Optional[Dict[str, str]] = None,
+                 request_fn: Optional[Callable[..., Dict[str, Any]]] = None):
+        if request_fn is None:
+            raise RuntimeError(
+                "GceApi needs an injected request_fn (authorized session): "
+                "this build has no network egress.")
+        self.project = project
+        self.zone = zone
+        self.machine_type = machine_type
+        self.source_image = source_image
+        self.network = network
+        self.labels = dict(labels or {})
+        self._request = request_fn
+
+    def _base(self) -> str:
+        return (f"/compute/v1/projects/{self.project}"
+                f"/zones/{self.zone}/instances")
+
+    def request_instances(self, count: int) -> List[str]:
+        names = []
+        for _ in range(count):
+            name = f"ray-tpu-{uuid.uuid4().hex[:10]}"
+            body = {
+                "name": name,
+                "machineType": (f"zones/{self.zone}/machineTypes/"
+                                f"{self.machine_type}"),
+                "disks": [{"boot": True, "initializeParams": {
+                    "sourceImage": self.source_image}}],
+                "networkInterfaces": [{"network":
+                                       f"global/networks/{self.network}"}],
+                "labels": self.labels,
+            }
+            self._request("POST", self._base(), body)
+            names.append(name)
+        return names
+
+    _GCE_STATE = {"PROVISIONING": REQUESTED, "STAGING": REQUESTED,
+                  "RUNNING": RUNNING, "STOPPING": TERMINATED,
+                  "TERMINATED": TERMINATED}
+
+    def describe_instances(self, ids: List[str]) -> List[VMRecord]:
+        reply = self._request("GET", self._base(), None)
+        out = []
+        wanted = set(ids)
+        for inst in reply.get("items", []):
+            if inst.get("name") not in wanted:
+                continue
+            ifaces = inst.get("networkInterfaces") or [{}]
+            out.append(VMRecord(
+                instance_id=inst["name"],
+                state=self._GCE_STATE.get(inst.get("status", ""),
+                                          REQUESTED),
+                ip=ifaces[0].get("networkIP", "")))
+        return out
+
+    def terminate_instances(self, ids: List[str]) -> None:
+        for iid in ids:
+            self._request("DELETE", f"{self._base()}/{iid}", None)
+
+
+class FakeVMApi(CloudVMApi):
+    """In-memory control plane: instances go REQUESTED → RUNNING with a
+    fake ip after `delay_s` (tests drive time with poll rounds)."""
+
+    def __init__(self, delay_s: float = 0.0):
+        self.delay_s = delay_s
+        self._instances: Dict[str, VMRecord] = {}
+        self._lock = threading.Lock()
+
+    def request_instances(self, count: int) -> List[str]:
+        ids = []
+        with self._lock:
+            for _ in range(count):
+                iid = f"fake-{uuid.uuid4().hex[:8]}"
+                self._instances[iid] = VMRecord(instance_id=iid)
+                ids.append(iid)
+        return ids
+
+    def describe_instances(self, ids: List[str]) -> List[VMRecord]:
+        out = []
+        now = time.time()
+        with self._lock:
+            for iid in ids:
+                rec = self._instances.get(iid)
+                if rec is None:
+                    continue
+                if (rec.state == REQUESTED
+                        and now - rec.created_at >= self.delay_s):
+                    rec.state = RUNNING
+                    rec.ip = f"10.0.0.{len(self._instances)}"
+                out.append(dataclasses.replace(rec))
+        return out
+
+    def terminate_instances(self, ids: List[str]) -> None:
+        with self._lock:
+            for iid in ids:
+                rec = self._instances.get(iid)
+                if rec is not None:
+                    rec.state = TERMINATED
+
+
+class CloudVMProvider(NodeProvider):
+    """NodeProvider over a CloudVMApi + CommandRunner bootstrap.
+
+    create_node returns immediately with a REQUESTED record; a poll thread
+    watches the api until the instance is RUNNING with an ip, then runs
+    `init_commands` + `start_command` through the runner factory (ssh,
+    optionally docker-wrapped). Failures mark the record FAILED and
+    terminate the cloud instance — never leak a billing VM (same rule the
+    TPU pod provider enforces for QueuedResources)."""
+
+    def __init__(self, api: CloudVMApi, *,
+                 resources_per_node: Optional[Dict[str, float]] = None,
+                 auth: Optional[Dict[str, Any]] = None,
+                 docker: Optional[Dict[str, Any]] = None,
+                 init_commands: Optional[List[str]] = None,
+                 start_command: str = "",
+                 runner_factory: Optional[
+                     Callable[[str], CommandRunner]] = None,
+                 poll_interval_s: float = 1.0,
+                 provision_timeout_s: float = 600.0):
+        self.api = api
+        self.resources_per_node = dict(resources_per_node or {"CPU": 1.0})
+        self.init_commands = list(init_commands or [])
+        self.start_command = start_command
+        self.poll_interval_s = poll_interval_s
+        self.provision_timeout_s = provision_timeout_s
+        self._runner_factory = runner_factory or (
+            lambda ip: make_runner(ip, auth=auth, docker=docker))
+        self._records: Dict[str, VMRecord] = {}
+        self._lock = threading.Lock()
+        self._poller: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- NodeProvider surface ------------------------------------------
+    def create_node(self, resources: Dict[str, float]) -> Any:
+        ids = self.api.request_instances(1)
+        with self._lock:
+            for iid in ids:
+                self._records[iid] = VMRecord(
+                    instance_id=iid,
+                    resources=dict(resources or self.resources_per_node))
+            self._ensure_poller()
+        return ids[0] if ids else None
+
+    def terminate_node(self, node: Any) -> None:
+        iid = node if isinstance(node, str) else getattr(
+            node, "instance_id", str(node))
+        self.api.terminate_instances([iid])
+        with self._lock:
+            rec = self._records.get(iid)
+            if rec is not None:
+                rec.state = TERMINATED
+
+    def nodes(self) -> List[Any]:
+        with self._lock:
+            return [r.instance_id for r in self._records.values()
+                    if r.state in (REQUESTED, RUNNING, BOOTSTRAPPING,
+                                   BOOTSTRAPPED)]
+
+    # -- lifecycle ------------------------------------------------------
+    def _ensure_poller(self) -> None:
+        if self._poller is None or not self._poller.is_alive():
+            self._poller = threading.Thread(
+                target=self._poll_loop, name="cloud-vm-poll", daemon=True)
+            self._poller.start()
+
+    def _pending_ids(self) -> List[str]:
+        with self._lock:
+            return [r.instance_id for r in self._records.values()
+                    if r.state == REQUESTED]
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            pending = self._pending_ids()
+            if not pending:
+                return  # poller exits; next create_node restarts it
+            try:
+                live = {r.instance_id: r
+                        for r in self.api.describe_instances(pending)}
+            except Exception as e:  # noqa: BLE001 — transient poll blip
+                logger.warning("describe_instances failed: %r", e)
+                self._stop.wait(self.poll_interval_s)
+                continue
+            for iid in pending:
+                rec = live.get(iid)
+                with self._lock:
+                    mine = self._records[iid]
+                    if rec is not None and rec.state == RUNNING and rec.ip:
+                        mine.ip = rec.ip
+                    elif (time.time() - mine.created_at
+                          > self.provision_timeout_s):
+                        mine.state = FAILED
+                        mine.error = "provision timeout"
+                    else:
+                        continue
+                if mine.state == FAILED:
+                    # Release the cloud resource — a timed-out VM must not
+                    # keep billing with no local record.
+                    try:
+                        self.api.terminate_instances([iid])
+                    except Exception:  # noqa: BLE001
+                        logger.exception("terminate after timeout failed")
+                    continue
+                # Bootstrap on its own thread: ssh/init commands run for
+                # minutes — inline they would serialize node bring-up and
+                # stall polling (and timeout expiry) for every other
+                # instance.
+                with self._lock:
+                    mine.state = BOOTSTRAPPING
+                threading.Thread(target=self._bootstrap, args=(mine,),
+                                 name=f"bootstrap-{iid}",
+                                 daemon=True).start()
+            self._stop.wait(self.poll_interval_s)
+
+    def _bootstrap(self, rec: VMRecord) -> None:
+        try:
+            runner = self._runner_factory(rec.ip)
+            runner.run_init_commands(self.init_commands)
+            if self.start_command:
+                rc, out = runner.run(self.start_command, timeout=600.0)
+                if rc != 0:
+                    raise RuntimeError(
+                        f"start command failed (rc={rc}): {out}")
+            with self._lock:
+                rec.state = BOOTSTRAPPED
+            logger.info("node %s bootstrapped at %s",
+                        rec.instance_id, rec.ip)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("bootstrap of %s failed", rec.instance_id)
+            with self._lock:
+                rec.state = FAILED
+                rec.error = repr(e)
+            try:
+                self.api.terminate_instances([rec.instance_id])
+            except Exception:  # noqa: BLE001
+                logger.exception("terminate after bootstrap failure failed")
+
+    def records(self) -> List[VMRecord]:
+        with self._lock:
+            return [dataclasses.replace(r) for r in self._records.values()]
+
+    def shutdown(self) -> None:
+        self._stop.set()

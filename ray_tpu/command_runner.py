@@ -1,0 +1,189 @@
+"""Command runners: how the autoscaler reaches into a node it just
+provisioned (reference: autoscaler/_private/command_runner.py —
+SSHCommandRunner + DockerCommandRunner; subprocess machinery redesigned:
+one `exec_fn` seam instead of the reference's process-pool + control-path
+caching, because the provider runs each node's bootstrap on its own
+thread — see CloudVMProvider._poll_loop — so runners are already
+concurrent per node).
+
+A runner executes shell commands "on the node" and pushes files to it.
+- LocalCommandRunner: the node is this host (fake/multinode tests, the
+  local provider).
+- SSHCommandRunner: builds standard ssh/scp argv. Zero-egress builds can't
+  reach a real VM, so the argv construction is the tested contract and
+  `exec_fn` is injectable (tests record the exact command lines).
+- DockerCommandRunner: wraps another runner; commands run inside a
+  container it ensures exists (reference: DockerCommandRunner wrapping
+  SSHCommandRunner).
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+ExecFn = Callable[[List[str], float], Tuple[int, str]]
+
+
+def _subprocess_exec(argv: List[str], timeout: float) -> Tuple[int, str]:
+    proc = subprocess.run(
+        argv, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=timeout)
+    return proc.returncode, proc.stdout.decode(errors="replace")
+
+
+class CommandRunner:
+    """Run shell commands / push files on a provisioned node."""
+
+    def run(self, cmd: str, timeout: float = 120.0) -> Tuple[int, str]:
+        raise NotImplementedError
+
+    def run_init_commands(self, commands: List[str],
+                          timeout: float = 600.0) -> None:
+        """Run node bootstrap commands in order; raise on first failure
+        (reference: NodeUpdater's setup_commands phase)."""
+        for cmd in commands:
+            rc, out = self.run(cmd, timeout=timeout)
+            if rc != 0:
+                raise RuntimeError(
+                    f"init command failed (rc={rc}): {cmd!r}\n{out}")
+
+    def sync_up(self, local_path: str, remote_path: str,
+                timeout: float = 600.0) -> None:
+        raise NotImplementedError
+
+
+class LocalCommandRunner(CommandRunner):
+    def __init__(self, exec_fn: Optional[ExecFn] = None):
+        self._exec = exec_fn or _subprocess_exec
+
+    def run(self, cmd: str, timeout: float = 120.0) -> Tuple[int, str]:
+        return self._exec(["bash", "-c", cmd], timeout)
+
+    def sync_up(self, local_path: str, remote_path: str,
+                timeout: float = 600.0) -> None:
+        rc, out = self._exec(["cp", "-r", local_path, remote_path], timeout)
+        if rc != 0:
+            raise RuntimeError(f"sync_up failed: {out}")
+
+
+class SSHCommandRunner(CommandRunner):
+    """argv-building ssh runner (reference: command_runner.py
+    SSHCommandRunner.run — same option set: batch mode, no host-key
+    prompts, connection timeout, optional identity file)."""
+
+    def __init__(self, ip: str, user: str = "ubuntu",
+                 key_path: Optional[str] = None,
+                 port: int = 22,
+                 connect_timeout_s: int = 10,
+                 exec_fn: Optional[ExecFn] = None):
+        self.ip = ip
+        self.user = user
+        self.key_path = key_path
+        self.port = port
+        self.connect_timeout_s = connect_timeout_s
+        self._exec = exec_fn or _subprocess_exec
+
+    def _ssh_base(self) -> List[str]:
+        argv = [
+            "ssh", "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null",
+            "-o", "BatchMode=yes",
+            "-o", f"ConnectTimeout={self.connect_timeout_s}s",
+            "-p", str(self.port),
+        ]
+        if self.key_path:
+            argv += ["-i", self.key_path]
+        return argv
+
+    def run(self, cmd: str, timeout: float = 120.0) -> Tuple[int, str]:
+        argv = self._ssh_base() + [f"{self.user}@{self.ip}", "--",
+                                   f"bash -c {shlex.quote(cmd)}"]
+        return self._exec(argv, timeout)
+
+    def sync_up(self, local_path: str, remote_path: str,
+                timeout: float = 600.0) -> None:
+        argv = ["scp", "-o", "StrictHostKeyChecking=no",
+                "-o", "UserKnownHostsFile=/dev/null",
+                "-P", str(self.port), "-r"]
+        if self.key_path:
+            argv += ["-i", self.key_path]
+        argv += [local_path, f"{self.user}@{self.ip}:{remote_path}"]
+        rc, out = self._exec(argv, timeout)
+        if rc != 0:
+            raise RuntimeError(f"scp failed (rc={rc}): {out}")
+
+
+class DockerCommandRunner(CommandRunner):
+    """Run inside a container on the node, via an inner runner
+    (reference: command_runner.py DockerCommandRunner — ensures the
+    container exists, then prefixes every command with docker exec)."""
+
+    def __init__(self, inner: CommandRunner, *, image: str,
+                 container_name: str = "ray_tpu_container",
+                 run_options: Optional[List[str]] = None):
+        self.inner = inner
+        self.image = image
+        self.container_name = container_name
+        self.run_options = list(run_options or [])
+        self._ensured = False
+
+    def ensure_container(self, timeout: float = 600.0) -> None:
+        if self._ensured:
+            return
+        opts = " ".join(self.run_options)
+        # Start-if-absent, reusing a stopped container of the same name.
+        cmd = (
+            f"docker start {self.container_name} 2>/dev/null || "
+            f"docker run -d --name {self.container_name} {opts} "
+            f"--network host {self.image} sleep infinity")
+        rc, out = self.inner.run(cmd, timeout=timeout)
+        if rc != 0:
+            raise RuntimeError(f"container start failed: {out}")
+        self._ensured = True
+
+    def run(self, cmd: str, timeout: float = 120.0) -> Tuple[int, str]:
+        self.ensure_container()
+        return self.inner.run(
+            f"docker exec {self.container_name} bash -c {shlex.quote(cmd)}",
+            timeout=timeout)
+
+    def sync_up(self, local_path: str, remote_path: str,
+                timeout: float = 600.0) -> None:
+        self.ensure_container()
+        staging = f"/tmp/ray_tpu_sync_{self.container_name}"
+        self.inner.sync_up(local_path, staging, timeout=timeout)
+        rc, out = self.inner.run(
+            f"docker cp {staging} {self.container_name}:{remote_path}",
+            timeout=timeout)
+        if rc != 0:
+            raise RuntimeError(f"docker cp failed: {out}")
+
+
+def make_runner(ip: str, auth: Optional[Dict[str, Any]] = None,
+                docker: Optional[Dict[str, Any]] = None,
+                exec_fn: Optional[ExecFn] = None) -> CommandRunner:
+    """Runner factory from cluster-YAML-shaped auth/docker sections
+    (reference: node_provider.get_command_runner)."""
+    auth = auth or {}
+    if ip in ("localhost", "127.0.0.1"):
+        runner: CommandRunner = LocalCommandRunner(exec_fn=exec_fn)
+    else:
+        runner = SSHCommandRunner(
+            ip,
+            user=auth.get("ssh_user", "ubuntu"),
+            key_path=auth.get("ssh_private_key"),
+            port=int(auth.get("ssh_port", 22)),
+            exec_fn=exec_fn)
+    if docker and docker.get("image"):
+        runner = DockerCommandRunner(
+            runner, image=docker["image"],
+            container_name=docker.get("container_name",
+                                      "ray_tpu_container"),
+            run_options=docker.get("run_options"))
+    return runner

@@ -990,8 +990,11 @@ class GcsServer:
     async def rpc_report_task_events(
             self, events: List[Dict[str, Any]]) -> None:
         self.task_events.extend(events)
-        for ev in events:
-            self._export_event("EXPORT_TASK", ev)
+        if self.export is not None:
+            try:
+                self.export.emit_many("EXPORT_TASK", events)
+            except Exception:  # noqa: BLE001
+                pass  # export is observability, never control flow
 
     async def rpc_list_task_events(
             self, limit: int = 1000) -> List[Dict[str, Any]]:

@@ -1026,6 +1026,41 @@ class Nodelet:
               if w.proc.poll() is None and w.address is not None])
         return {"node": self.node_name, "workers": dict(pairs)}
 
+    async def rpc_profile_workers(self, kind: str = "cpu",
+                                  duration: float = 5.0,
+                                  hz: float = 99.0,
+                                  worker_id_prefix: str = "",
+                                  top: int = 50) -> Dict[str, Any]:
+        """Run the sampling CPU profiler (kind="cpu" → folded stacks) or
+        the tracemalloc heap profiler (kind="heap") inside this node's
+        workers, concurrently (reference: reporter agent py-spy/memray
+        endpoints, dashboard/modules/reporter/). worker_id_prefix narrows
+        to one worker; default profiles every live worker on the node."""
+        method = "cpu_profile" if kind == "cpu" else "heap_profile"
+        kwargs = ({"duration": duration, "hz": hz} if kind == "cpu"
+                  else {"duration": duration, "top": top})
+
+        async def _one(wid, w):
+            client = None
+            try:
+                client = RpcClient(*w.address, name="profile")
+                return wid.hex()[:12], await client.call(
+                    method, timeout=duration + 30, **kwargs)
+            except Exception as e:  # noqa: BLE001
+                return wid.hex()[:12], {"error": repr(e)}
+            finally:
+                if client is not None:
+                    try:
+                        await client.close()
+                    except Exception:
+                        pass
+
+        targets = [(wid, w) for wid, w in list(self.workers.items())
+                   if w.proc.poll() is None and w.address is not None
+                   and wid.hex().startswith(worker_id_prefix)]
+        pairs = await asyncio.gather(*[_one(wid, w) for wid, w in targets])
+        return {"node": self.node_name, "workers": dict(pairs)}
+
     async def rpc_node_proc_stats(self) -> Dict[str, Any]:
         """Per-worker process stats from /proc (cpu seconds, rss, threads)
         plus the nodelet's own — the reporter-agent metrics floor."""

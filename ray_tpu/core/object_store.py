@@ -395,6 +395,13 @@ class MemoryStore:
         # ref-count callbacks that may re-enter this store.
         del obj
 
+    def pop(self, object_id: ObjectID):
+        """Remove and return the stored value (None when absent) — lets the
+        owner's ref-zero path see WHAT it is deleting (inline value vs shm
+        marker) and skip the arena/spill probes for inline objects."""
+        with self._lock:
+            return self._objects.pop(object_id, None)
+
     def size(self) -> int:
         with self._lock:
             return len(self._objects)

@@ -75,8 +75,44 @@ class DashboardActor:
         from ray_tpu.util import state
 
         loop = asyncio.get_running_loop()
+        path, _, query = path.partition("?")
+        params = {}
+        for part in query.split("&"):
+            if "=" in part:
+                k, _, v = part.partition("=")
+                params[k] = v
         if path == "/healthz":
             return 200, b'"ok"'
+        if path.rstrip("/") in ("/api/profile/cpu", "/api/profile/heap"):
+            # Worker profiling (reference: dashboard/modules/reporter/ —
+            # py-spy record → flamegraph and memray; see _private/profiler).
+            kind = path.rstrip("/").rsplit("/", 1)[-1]
+            try:
+                duration = min(float(params.get("duration",
+                                                5 if kind == "cpu" else 3)),
+                               120.0)
+                wid = params.get("worker", "")
+                if kind == "cpu":
+                    prof = await loop.run_in_executor(
+                        None, lambda: state.cpu_profile(
+                            duration=duration,
+                            hz=float(params.get("hz", 99)),
+                            worker_id_prefix=wid))
+                    if params.get("format") == "json":
+                        return 200, json.dumps(
+                            prof, default=_jsonable).encode()
+                    html = await loop.run_in_executor(
+                        None, lambda: state.flamegraph(prof))
+                    return 200, html.encode(), "text/html"
+                prof = await loop.run_in_executor(
+                    None, lambda: state.heap_profile(
+                        duration=duration,
+                        top=int(params.get("top", 50)),
+                        worker_id_prefix=wid))
+                return 200, json.dumps(prof, default=_jsonable).encode()
+            except Exception as e:
+                logger.exception("profile route failed")
+                return 500, json.dumps({"error": str(e)}).encode()
         if path == "/" or path == "/index.html":
             return 200, _load_ui(), "text/html"
         if path.rstrip("/") == "/api/timeline":

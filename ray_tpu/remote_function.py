@@ -12,6 +12,7 @@ class RemoteFunction:
     def __init__(self, fn, **default_options):
         self._fn = fn
         self._options = default_options
+        self._submit_kwargs = None  # computed on first .remote()
         functools.update_wrapper(self, fn)
 
     def __call__(self, *args, **kwargs):
@@ -34,34 +35,41 @@ class RemoteFunction:
             return api._global_client.remote(
                 self._fn, **self._options).remote(*args, **kwargs)
         w = worker_mod.global_worker()
-        opts = self._options
-        resources: Dict[str, float] = dict(opts.get("resources") or {})
-        num_cpus = opts.get("num_cpus")
-        num_tpus = opts.get("num_tpus")
-        resources.setdefault("CPU", 1.0 if num_cpus is None else float(num_cpus))
-        if num_tpus:
-            resources["TPU"] = float(num_tpus)
-        if opts.get("memory"):
-            resources["memory"] = float(opts["memory"])
-        num_returns = opts.get("num_returns", 1)
-        if num_returns == "dynamic":
-            num_returns = -1  # streaming generator (see _private/generators)
-        from ray_tpu.util.scheduling_strategies import to_internal
+        sub = self._submit_kwargs
+        if sub is None:
+            # Options are fixed per RemoteFunction instance (.options()
+            # returns a new one), so the derived submit arguments — the
+            # quantized ResourceSet, internal strategy, validated selector —
+            # are computed once, not per .remote() call.
+            opts = self._options
+            resources: Dict[str, float] = dict(opts.get("resources") or {})
+            num_cpus = opts.get("num_cpus")
+            num_tpus = opts.get("num_tpus")
+            resources.setdefault(
+                "CPU", 1.0 if num_cpus is None else float(num_cpus))
+            if num_tpus:
+                resources["TPU"] = float(num_tpus)
+            if opts.get("memory"):
+                resources["memory"] = float(opts["memory"])
+            num_returns = opts.get("num_returns", 1)
+            if num_returns == "dynamic":
+                num_returns = -1  # streaming generator (_private/generators)
+            from ray_tpu._private.task_spec import ResourceSet
+            from ray_tpu.util.scheduling_strategies import to_internal
 
-        refs = w.submit_task(
-            self._fn,
-            args,
-            kwargs,
-            num_returns=num_returns,
-            resources=resources,
-            scheduling_strategy=to_internal(opts.get("scheduling_strategy")),
-            max_retries=opts.get("max_retries"),
-            retry_exceptions=bool(opts.get("retry_exceptions", False)),
-            runtime_env=opts.get("runtime_env"),
-            label_selector=opts.get("label_selector"),
-            function_name=self._fn.__name__,
-        )
-        if num_returns in (1, -1):
+            self._submit_kwargs = sub = dict(
+                num_returns=num_returns,
+                resources=ResourceSet(resources),
+                scheduling_strategy=to_internal(
+                    opts.get("scheduling_strategy")),
+                max_retries=opts.get("max_retries"),
+                retry_exceptions=bool(opts.get("retry_exceptions", False)),
+                runtime_env=opts.get("runtime_env"),
+                label_selector=opts.get("label_selector"),
+                function_name=self._fn.__name__,
+            )
+        refs = w.submit_task(self._fn, args, kwargs, **sub)
+        if sub["num_returns"] in (1, -1):
             return refs[0]
         return refs
 

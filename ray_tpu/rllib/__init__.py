@@ -13,6 +13,7 @@ from ray_tpu.rllib.dqn import (
     ReplayBuffer,
 )
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.appo import APPO, APPOConfig, APPOLearner
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner
 from ray_tpu.rllib.learner import (
     LearnerGroup,
@@ -26,6 +27,9 @@ from ray_tpu.rllib.sac import SAC, SACConfig, SACLearner, SACModule
 from ray_tpu.rllib.vector import SyncVectorEnv, as_batch_env
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
+    "APPOLearner",
     "ConvActorCriticNet",
     "SAC",
     "SACConfig",

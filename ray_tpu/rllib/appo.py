@@ -1,0 +1,149 @@
+"""APPO — asynchronous PPO (reference: rllib/algorithms/appo/ — IMPALA's
+actor-learner architecture with PPO's clipped surrogate on top of V-trace
+advantages, plus a slow "target" policy whose KL anchors the updates while
+rollouts arrive with policy lag).
+
+TPU-first like IMPALA here: the whole update (V-trace scan + clipped
+surrogate + KL vs target) is ONE jitted program; the target params live on
+device and refresh by a counter inside the training loop, not a second
+network copy on host. The async plumbing (runners always in flight,
+consume-whichever-finished) is inherited from IMPALA unchanged — APPO is
+the learner swap the reference describes, not a new control loop."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.impala import (
+    IMPALA,
+    IMPALAConfig,
+    IMPALALearnerConfig,
+    vtrace_targets,
+)
+from ray_tpu.rllib.rl_module import RLModule
+
+
+@dataclasses.dataclass
+class APPOLearnerConfig(IMPALALearnerConfig):
+    clip_param: float = 0.2  # PPO surrogate clip (reference appo defaults)
+    kl_coeff: float = 0.2  # KL(target || current) penalty weight
+    target_update_freq: int = 8  # learner updates between target refreshes
+
+
+class APPOLearner:
+    """Jitted V-trace + clipped-surrogate update with a target policy."""
+
+    def __init__(self, module: RLModule, config: APPOLearnerConfig,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.module = module
+        self.cfg = config
+        self.opt = optax.chain(
+            optax.clip_by_global_norm(config.max_grad_norm),
+            optax.adam(config.lr))
+        self.params = module.init_params(jax.random.PRNGKey(seed))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt_state = self.opt.init(self.params)
+        self._updates_since_target = 0
+        net = module.net
+        cfg = config
+
+        def loss_fn(params, target_params, batch):
+            T, N = batch["actions"].shape
+            obs = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
+            logits, values = net.apply({"params": params}, obs)
+            logits = logits.reshape(T, N, -1)
+            values = values.reshape(T, N)
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+            # Importance ratio vs the BEHAVIOR policy that sampled the
+            # rollout (may be several updates stale — that is the "A").
+            rhos = jnp.exp(logp - batch["behavior_logp"])
+            vs, pg_adv = vtrace_targets(
+                jax.lax.stop_gradient(values), batch["next_value"],
+                batch["rewards"], batch["dones"],
+                jax.lax.stop_gradient(rhos),
+                gamma=cfg.gamma, rho_clip=cfg.rho_clip, c_clip=cfg.c_clip)
+            adv = jax.lax.stop_gradient(pg_adv)
+            # PPO clipped surrogate on the behavior ratio (reference:
+            # appo_torch_learner.compute_loss_for_module).
+            surr = jnp.minimum(
+                rhos * adv,
+                jnp.clip(rhos, 1.0 - cfg.clip_param,
+                         1.0 + cfg.clip_param) * adv)
+            pg_loss = -jnp.mean(surr)
+            vf_loss = jnp.mean((values - jax.lax.stop_gradient(vs)) ** 2)
+            entropy = -jnp.mean(jnp.sum(
+                jax.nn.softmax(logits) * logp_all, axis=-1))
+            # KL(target || current) over the rollout states anchors fast
+            # async updates to the slow policy.
+            tlogits, _ = net.apply({"params": target_params}, obs)
+            tlogp_all = jax.nn.log_softmax(tlogits.reshape(T, N, -1))
+            kl = jnp.mean(jnp.sum(
+                jnp.exp(tlogp_all) * (tlogp_all - logp_all), axis=-1))
+            loss = (pg_loss + cfg.vf_coeff * vf_loss
+                    - cfg.entropy_coeff * entropy + cfg.kl_coeff * kl)
+            return loss, (pg_loss, vf_loss, kl)
+
+        def update(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, aux
+
+        self._update = jax.jit(update, donate_argnums=(0, 2))
+
+    def update(self, rollout: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        batch = {
+            "obs": jnp.asarray(rollout["obs"], jnp.float32),
+            "actions": jnp.asarray(rollout["actions"], jnp.int32),
+            "behavior_logp": jnp.asarray(rollout["logp"], jnp.float32),
+            "rewards": jnp.asarray(rollout["rewards"], jnp.float32),
+            "dones": jnp.asarray(rollout["dones"], jnp.float32),
+            "next_value": jnp.asarray(rollout["last_values"], jnp.float32),
+        }
+        self.params, self.opt_state, loss, aux = self._update(
+            self.params, self.target_params, self.opt_state, batch)
+        self._updates_since_target += 1
+        if self._updates_since_target >= self.cfg.target_update_freq:
+            self._updates_since_target = 0
+            import jax.numpy as jnp
+
+            # real copy: params are donated into the next update — an
+            # aliased target would hand XLA the same buffer twice
+            self.target_params = jax.tree.map(jnp.copy, self.params)
+        pg, vf, kl = (float(x) for x in aux)
+        return {"loss": float(loss), "pg_loss": pg, "vf_loss": vf,
+                "kl": kl}
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.learner = APPOLearnerConfig()
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    """IMPALA's async loop with the APPO learner (reference: appo.py
+    subclasses IMPALA the same way)."""
+
+    LEARNER_CLS = APPOLearner

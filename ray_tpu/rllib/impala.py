@@ -193,6 +193,8 @@ class IMPALA:
     weights + a new in-flight request (reference: impala.py
     training_step's learner/actor decoupling)."""
 
+    LEARNER_CLS = IMPALALearner  # subclasses (APPO) swap the learner
+
     def __init__(self, config: IMPALAConfig):
         assert config._env_fn is not None, "call .environment(...) first"
         self.config = config
@@ -200,7 +202,8 @@ class IMPALA:
         obs_dim = int(np.prod(probe.observation_space.shape))
         num_actions = int(probe.action_space.n)
         self.module = RLModule(obs_dim, num_actions, config.hidden)
-        self.learner = IMPALALearner(self.module, config.learner, config.seed)
+        self.learner = self.LEARNER_CLS(self.module, config.learner,
+                                        config.seed)
         Runner = ray_tpu.remote(SingleAgentEnvRunner)
         self.runners = [
             Runner.options(num_cpus=1.0).remote(

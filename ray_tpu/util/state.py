@@ -72,7 +72,8 @@ def list_workers() -> List[Dict[str, Any]]:
     return w.loop_thread.run(_collect())
 
 
-def _collect_per_node(method: str) -> Dict[str, Any]:
+def _collect_per_node(method: str, timeout: float = 30,
+                      **kwargs) -> Dict[str, Any]:
     import asyncio
 
     w = worker_mod.global_worker()
@@ -81,7 +82,7 @@ def _collect_per_node(method: str) -> Dict[str, Any]:
         try:
             client = await w.nodelet_client_for_node(n["node_id"])
             return n["node_id"].hex()[:12], await asyncio.wait_for(
-                client.call(method), 30)
+                client.call(method, **kwargs), timeout)
         except Exception as e:  # noqa: BLE001
             return n["node_id"].hex()[:12], {"error": repr(e)}
 
@@ -107,6 +108,49 @@ def node_proc_stats() -> Dict[str, Any]:
     """Per-process cpu/rss/threads for every node's workers (reference:
     the reporter agent's psutil sampling)."""
     return _collect_per_node("node_proc_stats")
+
+
+def cpu_profile(duration: float = 5.0, hz: float = 99.0,
+                worker_id_prefix: str = "") -> Dict[str, Any]:
+    """Sampling CPU profile of every worker (or one, by id prefix) on every
+    node → {node: {worker: {"folded": ..., "samples": N}}} (reference: the
+    reporter agent's py-spy record endpoint; `ray_tpu.util.state` is the
+    `ray status`-family surface). Render with flamegraph()."""
+    return _collect_per_node("profile_workers", kind="cpu",
+                             duration=duration, hz=hz,
+                             worker_id_prefix=worker_id_prefix,
+                             timeout=duration + 60)
+
+
+def heap_profile(duration: float = 3.0, top: int = 50,
+                 worker_id_prefix: str = "") -> Dict[str, Any]:
+    """tracemalloc heap profile of workers: top live allocation sites and
+    window growers (reference: the reporter agent's memray endpoint)."""
+    return _collect_per_node("profile_workers", kind="heap",
+                             duration=duration, top=top,
+                             worker_id_prefix=worker_id_prefix,
+                             timeout=duration + 60)
+
+
+def flamegraph(profile: Optional[Dict[str, Any]] = None,
+               path: Optional[str] = None, **kwargs) -> str:
+    """One self-contained flamegraph HTML over all profiled workers.
+    Takes a cpu_profile() result (or runs one with **kwargs) and merges
+    per-worker folded stacks under worker-labelled roots; writes to
+    `path` when given, returns the HTML either way."""
+    from ray_tpu._private import profiler
+
+    if profile is None:
+        profile = cpu_profile(**kwargs)
+    pairs = []
+    for node, reply in profile.items():
+        for wid, prof in (reply.get("workers") or {}).items():
+            pairs.append((f"{node}/{wid}", prof))
+    html = profiler.flamegraph_html(profiler.merge_folded(pairs))
+    if path:
+        with open(path, "w") as f:
+            f.write(html)
+    return html
 
 
 def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:

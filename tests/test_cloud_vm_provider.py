@@ -1,0 +1,225 @@
+"""Command runners + generic cloud-VM provider (reference:
+autoscaler/_private/command_runner.py, aws/node_provider.py,
+gcp/node_provider.py). Zero-egress build: the tested contract is the
+wire payloads / ssh argv, plus the full provider lifecycle over the fake
+control plane."""
+
+import time
+
+import pytest
+
+from ray_tpu.cloud_vm_provider import (
+    BOOTSTRAPPED, FAILED, CloudVMProvider, Ec2Api, FakeVMApi, GceApi,
+    TERMINATED,
+)
+from ray_tpu.command_runner import (
+    DockerCommandRunner, LocalCommandRunner, SSHCommandRunner, make_runner,
+)
+
+
+class RecordingExec:
+    def __init__(self, rc=0, out="ok"):
+        self.calls = []
+        self.rc = rc
+        self.out = out
+
+    def __call__(self, argv, timeout):
+        self.calls.append(list(argv))
+        return self.rc, self.out
+
+
+def test_ssh_runner_argv():
+    ex = RecordingExec()
+    r = SSHCommandRunner("10.1.2.3", user="tpu", key_path="/k.pem",
+                         exec_fn=ex)
+    rc, out = r.run("echo hello && uptime")
+    assert rc == 0
+    argv = ex.calls[0]
+    assert argv[0] == "ssh"
+    assert "BatchMode=yes" in argv
+    assert "StrictHostKeyChecking=no" in argv
+    assert "/k.pem" in argv
+    assert "tpu@10.1.2.3" in argv
+    # the remote command is a single quoted bash -c argument
+    assert argv[-1].startswith("bash -c ")
+    assert "echo hello" in argv[-1]
+
+    r.sync_up("/local/dir", "/remote/dir")
+    scp = ex.calls[1]
+    assert scp[0] == "scp" and scp[-1] == "tpu@10.1.2.3:/remote/dir"
+
+
+def test_docker_runner_wraps_inner():
+    ex = RecordingExec()
+    inner = LocalCommandRunner(exec_fn=ex)
+    d = DockerCommandRunner(inner, image="ray_tpu:latest",
+                            container_name="c1")
+    rc, _ = d.run("python -V")
+    assert rc == 0
+    joined = [" ".join(c) for c in ex.calls]
+    # first call ensures the container, second execs inside it
+    assert "docker run -d --name c1" in joined[0]
+    assert "ray_tpu:latest" in joined[0]
+    assert "docker exec c1" in joined[1]
+    # ensure_container only happens once
+    d.run("ls")
+    assert sum("docker run" in j for j in
+               [" ".join(c) for c in ex.calls]) == 1
+
+
+def test_make_runner_local_vs_ssh_vs_docker():
+    ex = RecordingExec()
+    assert isinstance(make_runner("127.0.0.1", exec_fn=ex),
+                      LocalCommandRunner)
+    assert isinstance(make_runner("10.0.0.9", exec_fn=ex),
+                      SSHCommandRunner)
+    r = make_runner("10.0.0.9", docker={"image": "img"}, exec_fn=ex)
+    assert isinstance(r, DockerCommandRunner)
+    assert isinstance(r.inner, SSHCommandRunner)
+
+
+def test_ec2_api_wire_shapes():
+    sent = []
+
+    def request_fn(params):
+        sent.append(params)
+        if params["Action"] == "RunInstances":
+            return {"Instances": [{"InstanceId": "i-0abc"}]}
+        if params["Action"] == "DescribeInstances":
+            return {"Reservations": [{"Instances": [{
+                "InstanceId": "i-0abc",
+                "State": {"Name": "running"},
+                "PrivateIpAddress": "172.31.0.5"}]}]}
+        return {}
+
+    api = Ec2Api(image_id="ami-123", instance_type="m5.large",
+                 subnet_id="subnet-9", key_name="kp",
+                 tags={"ray-cluster": "main"}, request_fn=request_fn)
+    ids = api.request_instances(1)
+    assert ids == ["i-0abc"]
+    run = sent[0]
+    assert run["Action"] == "RunInstances"
+    assert run["ImageId"] == "ami-123"
+    assert run["InstanceType"] == "m5.large"
+    assert run["MinCount"] == run["MaxCount"] == 1
+    assert run["SubnetId"] == "subnet-9"
+    assert run["TagSpecification.1.Tag.1.Key"] == "ray-cluster"
+
+    recs = api.describe_instances(ids)
+    assert sent[1]["InstanceId.1"] == "i-0abc"
+    assert recs[0].ip == "172.31.0.5" and recs[0].state == "RUNNING"
+
+    api.terminate_instances(ids)
+    assert sent[2]["Action"] == "TerminateInstances"
+
+
+def test_gce_api_wire_shapes():
+    sent = []
+
+    def request_fn(method, path, body):
+        sent.append((method, path, body))
+        if method == "GET":
+            return {"items": [{
+                "name": sent[0][2]["name"],
+                "status": "RUNNING",
+                "networkInterfaces": [{"networkIP": "10.128.0.7"}]}]}
+        return {}
+
+    api = GceApi(project="proj", zone="us-central1-a",
+                 machine_type="n2-standard-8",
+                 source_image="projects/x/global/images/img",
+                 labels={"cluster": "main"}, request_fn=request_fn)
+    ids = api.request_instances(1)
+    method, path, body = sent[0]
+    assert method == "POST"
+    assert path == "/compute/v1/projects/proj/zones/us-central1-a/instances"
+    assert body["machineType"].endswith("machineTypes/n2-standard-8")
+    assert body["disks"][0]["initializeParams"]["sourceImage"]
+    assert body["labels"] == {"cluster": "main"}
+
+    recs = api.describe_instances(ids)
+    assert recs[0].state == "RUNNING" and recs[0].ip == "10.128.0.7"
+
+    api.terminate_instances(ids)
+    assert sent[-1][0] == "DELETE" and sent[-1][1].endswith(ids[0])
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_provider_lifecycle_bootstraps_and_terminates():
+    api = FakeVMApi(delay_s=0.1)
+    ran = []
+
+    class FakeRunner:
+        def __init__(self, ip):
+            self.ip = ip
+
+        def run_init_commands(self, commands, timeout=600.0):
+            ran.extend((self.ip, c) for c in commands)
+
+        def run(self, cmd, timeout=120.0):
+            ran.append((self.ip, cmd))
+            return 0, "ok"
+
+    prov = CloudVMProvider(
+        api, init_commands=["apt-get install -y foo"],
+        start_command="ray_tpu start --address=head:1234",
+        runner_factory=FakeRunner, poll_interval_s=0.05)
+    try:
+        nid = prov.create_node({"CPU": 8.0})
+        assert nid in prov.nodes()
+        assert _wait(lambda: any(r.state == BOOTSTRAPPED
+                                 for r in prov.records()))
+        cmds = [c for _, c in ran]
+        assert cmds == ["apt-get install -y foo",
+                        "ray_tpu start --address=head:1234"]
+        prov.terminate_node(nid)
+        assert nid not in prov.nodes()
+        assert api.describe_instances([nid])[0].state == TERMINATED
+    finally:
+        prov.shutdown()
+
+
+def test_provider_bootstrap_failure_releases_instance():
+    api = FakeVMApi(delay_s=0.0)
+
+    class FailingRunner:
+        def __init__(self, ip):
+            pass
+
+        def run_init_commands(self, commands, timeout=600.0):
+            raise RuntimeError("ssh unreachable")
+
+    prov = CloudVMProvider(api, init_commands=["x"],
+                           runner_factory=FailingRunner,
+                           poll_interval_s=0.05)
+    try:
+        nid = prov.create_node({})
+        assert _wait(lambda: any(r.state == FAILED
+                                 for r in prov.records()))
+        # the cloud instance was released, not leaked
+        assert api.describe_instances([nid])[0].state == TERMINATED
+        assert nid not in prov.nodes()
+    finally:
+        prov.shutdown()
+
+
+def test_provider_provision_timeout_releases_instance():
+    api = FakeVMApi(delay_s=60.0)  # never comes up within the test
+    prov = CloudVMProvider(api, runner_factory=lambda ip: None,
+                           poll_interval_s=0.05,
+                           provision_timeout_s=0.2)
+    try:
+        nid = prov.create_node({})
+        assert _wait(lambda: any(r.state == FAILED
+                                 for r in prov.records()))
+        assert api.describe_instances([nid])[0].state == TERMINATED
+    finally:
+        prov.shutdown()

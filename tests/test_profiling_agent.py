@@ -65,3 +65,70 @@ def test_cli_stack_command(ray_start_regular):
 
     blob = json.dumps(out, default=str)
     assert "stacks" in blob or "error" in blob
+
+
+def test_cpu_profile_flamegraph(ray_start_regular):
+    """Sampling profiler catches a busy worker; folded stacks name the hot
+    function; the flamegraph renders self-contained HTML (reference:
+    reporter_agent.py py-spy record endpoint)."""
+
+    @ray_tpu.remote
+    class Burner:
+        def burn(self, s):
+            end = time.time() + s
+            x = 0
+            while time.time() < end:
+                x += 1
+            return x
+
+    b = Burner.remote()
+    ray_tpu.get(b.burn.remote(0.01))  # worker up
+    ref = b.burn.remote(6.0)
+    prof = state.cpu_profile(duration=2.0, hz=50)
+    assert prof
+    all_folded = {}
+    for node in prof.values():
+        assert "error" not in node, node
+        for wprof in (node.get("workers") or {}).values():
+            assert "error" not in wprof, wprof
+            assert wprof["samples"] > 0
+            all_folded.update(wprof.get("folded") or {})
+    assert any("burn" in k for k in all_folded), list(all_folded)[:5]
+    html = state.flamegraph(prof)
+    assert "<script>" in html and "burn" in html
+    ray_tpu.get(ref, timeout=60)
+
+
+def test_heap_profile_reports_sites(ray_start_regular):
+    """tracemalloc heap endpoint reports allocation sites for a worker
+    holding a large allocation (reference: reporter_agent.py memray)."""
+
+    @ray_tpu.remote
+    class Holder:
+        def grab(self):
+            self.blob = [bytes(1024) for _ in range(2000)]
+            return len(self.blob)
+
+        def grow_during(self, s):
+            # allocate steadily while the window is open
+            end = time.time() + s
+            self.extra = []
+            while time.time() < end:
+                self.extra.append(bytes(4096))
+                time.sleep(0.005)
+            return len(self.extra)
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.grab.remote()) == 2000
+    ref = h.grow_during.remote(4.0)
+    prof = state.heap_profile(duration=2.0, top=20)
+    found = False
+    for node in prof.values():
+        for wprof in (node.get("workers") or {}).values():
+            if "error" in wprof:
+                continue
+            if wprof.get("top_live") or wprof.get("top_growers"):
+                assert wprof["traced_current_kb"] >= 0
+                found = True
+    assert found, prof
+    ray_tpu.get(ref, timeout=60)
