@@ -32,13 +32,6 @@ class ExportEventLogger:
     """Per-process JSONL event writer with size rotation (one backup,
     like the reference's spdlog rotating sink)."""
 
-    # Consumers tail the files, so buffered lines are pushed out within
-    # FLUSH_INTERVAL_S rather than per event: the task channel can carry
-    # thousands of events/s and a write syscall per line is measurable on
-    # the GCS (reference: the C++ exporter sits on spdlog's async sink
-    # for the same reason).
-    FLUSH_INTERVAL_S = 0.5
-
     def __init__(self, directory: str,
                  max_bytes: int = 50 * 1024 * 1024):
         self.directory = directory
@@ -46,7 +39,6 @@ class ExportEventLogger:
         self._lock = threading.Lock()
         self._files: Dict[str, Any] = {}
         self._sizes: Dict[str, int] = {}
-        self._next_flush = 0.0
         self._seq = 0
         self._prefix = uuid.uuid4().hex[:16]
         os.makedirs(directory, exist_ok=True)
@@ -91,11 +83,11 @@ class ExportEventLogger:
                     self._sizes[source_type] = 0
                 f.write(data)
                 self._sizes[source_type] += len(data)
-                mono = time.monotonic()
-                if mono >= self._next_flush:
-                    self._next_flush = mono + self.FLUSH_INTERVAL_S
-                    for fh in self._files.values():
-                        fh.flush()
+                # One write+flush per BATCH (vs the old line-buffered
+                # flush per event): tail consumers see a burst's last
+                # event immediately, and the GCS pays one syscall per
+                # report_task_events batch, not per task.
+                f.flush()
             except OSError:
                 pass  # export is best-effort; never block the component
 

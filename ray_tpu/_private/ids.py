@@ -20,17 +20,29 @@ _HEX = "0123456789abcdef"
 # id.h TaskID::ForNormalTask) rather than drawing fresh entropy. The pid
 # check makes this fork-safe (workers fork from the zygote).
 _ID_STATE = [0, b"", None]  # [pid, 8-byte prefix, counter]
+_ID_INIT_LOCK = None  # created lazily to keep import side effects nil
 
 
 def _next12() -> bytes:
-    import itertools
-
     st = _ID_STATE
     pid = os.getpid()
     if st[0] != pid:
-        st[1] = os.urandom(8)
-        st[2] = itertools.count(1)  # C-level next(): thread-atomic
-        st[0] = pid
+        # (Re)initialize under a lock: two first-use threads racing the
+        # init would otherwise reset the counter after the other had
+        # already drawn from it (duplicate IDs). st[0] is assigned LAST
+        # so lock-free fast-path readers only proceed on a fully built
+        # state.
+        global _ID_INIT_LOCK
+        import itertools
+        import threading
+
+        if _ID_INIT_LOCK is None:
+            _ID_INIT_LOCK = threading.Lock()
+        with _ID_INIT_LOCK:
+            if st[0] != pid:
+                st[1] = os.urandom(8)
+                st[2] = itertools.count(1)  # C-level next(): atomic
+                st[0] = pid
     return st[1] + (next(st[2]) & 0xFFFFFFFF).to_bytes(4, "big")
 
 

@@ -90,6 +90,11 @@ def set_global_worker(w: Optional["Worker"]) -> None:
         _global_worker = w
 
 
+# Absent-key sentinel for MemoryStore.pop (a stored None is a real inline
+# value — tasks returning None are common and take the fast path).
+_MISSING = object()
+
+
 class ShmMarker:
     """Memory-store placeholder meaning 'value lives in the shm store of
     node_id'."""
@@ -1030,9 +1035,9 @@ class Worker:
             from ray_tpu.experimental import device_objects as devobj
 
             devobj.on_owner_ref_zero(self, object_id)
-        val = self.memory_store.pop(object_id)
+        val = self.memory_store.pop(object_id, _MISSING)
         self.task_manager.drop_lineage(object_id)
-        if val is not None and not isinstance(val, ShmMarker):
+        if val is not _MISSING and not isinstance(val, ShmMarker):
             # Inline value: it never touched the arena and inline objects
             # are never spilled — done. (Small task returns dominate ref
             # churn; the arena probe + spill unlink are syscalls.)

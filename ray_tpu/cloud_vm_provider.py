@@ -212,6 +212,7 @@ class FakeVMApi(CloudVMApi):
     def __init__(self, delay_s: float = 0.0):
         self.delay_s = delay_s
         self._instances: Dict[str, VMRecord] = {}
+        self._ip_counter = 0
         self._lock = threading.Lock()
 
     def request_instances(self, count: int) -> List[str]:
@@ -234,7 +235,8 @@ class FakeVMApi(CloudVMApi):
                 if (rec.state == REQUESTED
                         and now - rec.created_at >= self.delay_s):
                     rec.state = RUNNING
-                    rec.ip = f"10.0.0.{len(self._instances)}"
+                    self._ip_counter += 1
+                    rec.ip = f"10.0.0.{self._ip_counter}"
                 out.append(dataclasses.replace(rec))
         return out
 
@@ -307,6 +309,7 @@ class CloudVMProvider(NodeProvider):
 
     # -- lifecycle ------------------------------------------------------
     def _ensure_poller(self) -> None:
+        # Callers hold self._lock (see _poll_loop's exit protocol).
         if self._poller is None or not self._poller.is_alive():
             self._poller = threading.Thread(
                 target=self._poll_loop, name="cloud-vm-poll", daemon=True)
@@ -321,7 +324,16 @@ class CloudVMProvider(NodeProvider):
         while not self._stop.is_set():
             pending = self._pending_ids()
             if not pending:
-                return  # poller exits; next create_node restarts it
+                # Exit only while holding the lock and with no REQUESTED
+                # records: create_node inserts records and checks poller
+                # liveness under the same lock, so a VM requested while
+                # this thread winds down cannot be stranded unwatched.
+                with self._lock:
+                    if not any(r.state == REQUESTED
+                               for r in self._records.values()):
+                        self._poller = None
+                        return
+                continue
             try:
                 live = {r.instance_id: r
                         for r in self.api.describe_instances(pending)}

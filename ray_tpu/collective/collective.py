@@ -143,9 +143,23 @@ def _takes_device_path(value) -> bool:
 
 
 def _device_reduce(arrays: List[Any], op: str):
-    """Jitted on-device reduction of the gathered contributions (jit
-    caches by (op, shape, dtype) via the closure-free signature)."""
-    import jax
+    """Reduce the gathered contributions locally: jitted on-device when
+    jax is importable here, numpy otherwise (a jax-less rank can legally
+    share a round with device ranks — its peers' envelopes resolve to
+    host arrays on fetch)."""
+    try:
+        import jax.numpy as jnp  # noqa: F401
+    except Exception:
+        stackednp = np.stack([np.asarray(a) for a in arrays])
+        if op == "sum":
+            return stackednp.sum(axis=0)
+        if op == "mean":
+            return stackednp.mean(axis=0)
+        if op == "max":
+            return stackednp.max(axis=0)
+        if op == "min":
+            return stackednp.min(axis=0)
+        raise ValueError(f"unknown reduce op {op!r}")
     import jax.numpy as jnp
 
     stacked = jnp.stack(arrays)

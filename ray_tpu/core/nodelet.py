@@ -1002,50 +1002,18 @@ class Nodelet:
     # stats, dashboard/modules/reporter/; here native: sys._current_frames
     # in-worker and /proc sampling here)
     # ------------------------------------------------------------------
-    async def rpc_node_stacks(self) -> Dict[str, Any]:
-        """All-thread python stacks for every live worker on this node,
-        gathered concurrently (the `ray stack` surface)."""
+    async def _fanout_workers(self, method: str, *, timeout: float = 10.0,
+                              worker_id_prefix: str = "",
+                              **kwargs) -> Dict[str, Any]:
+        """Call one RPC on every live worker concurrently, error-wrapped
+        per worker (shared scaffolding for the reporter endpoints)."""
 
         async def _one(wid, w):
             client = None
             try:
-                client = RpcClient(*w.address, name="stacks")
+                client = RpcClient(*w.address, name=method)
                 return wid.hex()[:12], await client.call(
-                    "dump_stacks", timeout=10)
-            except Exception as e:  # noqa: BLE001
-                return wid.hex()[:12], {"error": repr(e)}
-            finally:
-                if client is not None:
-                    try:
-                        await client.close()
-                    except Exception:
-                        pass
-
-        pairs = await asyncio.gather(
-            *[_one(wid, w) for wid, w in list(self.workers.items())
-              if w.proc.poll() is None and w.address is not None])
-        return {"node": self.node_name, "workers": dict(pairs)}
-
-    async def rpc_profile_workers(self, kind: str = "cpu",
-                                  duration: float = 5.0,
-                                  hz: float = 99.0,
-                                  worker_id_prefix: str = "",
-                                  top: int = 50) -> Dict[str, Any]:
-        """Run the sampling CPU profiler (kind="cpu" → folded stacks) or
-        the tracemalloc heap profiler (kind="heap") inside this node's
-        workers, concurrently (reference: reporter agent py-spy/memray
-        endpoints, dashboard/modules/reporter/). worker_id_prefix narrows
-        to one worker; default profiles every live worker on the node."""
-        method = "cpu_profile" if kind == "cpu" else "heap_profile"
-        kwargs = ({"duration": duration, "hz": hz} if kind == "cpu"
-                  else {"duration": duration, "top": top})
-
-        async def _one(wid, w):
-            client = None
-            try:
-                client = RpcClient(*w.address, name="profile")
-                return wid.hex()[:12], await client.call(
-                    method, timeout=duration + 30, **kwargs)
+                    method, timeout=timeout, **kwargs)
             except Exception as e:  # noqa: BLE001
                 return wid.hex()[:12], {"error": repr(e)}
             finally:
@@ -1060,6 +1028,28 @@ class Nodelet:
                    and wid.hex().startswith(worker_id_prefix)]
         pairs = await asyncio.gather(*[_one(wid, w) for wid, w in targets])
         return {"node": self.node_name, "workers": dict(pairs)}
+
+    async def rpc_node_stacks(self) -> Dict[str, Any]:
+        """All-thread python stacks for every live worker on this node,
+        gathered concurrently (the `ray stack` surface)."""
+        return await self._fanout_workers("dump_stacks")
+
+    async def rpc_profile_workers(self, kind: str = "cpu",
+                                  duration: float = 5.0,
+                                  hz: float = 99.0,
+                                  worker_id_prefix: str = "",
+                                  top: int = 50) -> Dict[str, Any]:
+        """Run the sampling CPU profiler (kind="cpu" → folded stacks) or
+        the tracemalloc heap profiler (kind="heap") inside this node's
+        workers, concurrently (reference: reporter agent py-spy/memray
+        endpoints, dashboard/modules/reporter/). worker_id_prefix narrows
+        to one worker; default profiles every live worker on the node."""
+        method = "cpu_profile" if kind == "cpu" else "heap_profile"
+        kwargs = ({"duration": duration, "hz": hz} if kind == "cpu"
+                  else {"duration": duration, "top": top})
+        return await self._fanout_workers(
+            method, timeout=duration + 30,
+            worker_id_prefix=worker_id_prefix, **kwargs)
 
     async def rpc_node_proc_stats(self) -> Dict[str, Any]:
         """Per-worker process stats from /proc (cpu seconds, rss, threads)

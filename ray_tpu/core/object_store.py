@@ -395,12 +395,14 @@ class MemoryStore:
         # ref-count callbacks that may re-enter this store.
         del obj
 
-    def pop(self, object_id: ObjectID):
-        """Remove and return the stored value (None when absent) — lets the
-        owner's ref-zero path see WHAT it is deleting (inline value vs shm
-        marker) and skip the arena/spill probes for inline objects."""
+    def pop(self, object_id: ObjectID, default=None):
+        """Remove and return the stored value (default when absent) — lets
+        the owner's ref-zero path see WHAT it is deleting (inline value vs
+        shm marker) and skip the arena/spill probes for inline objects.
+        Pass a sentinel default to distinguish a stored None from absent
+        (tasks returning None are common)."""
         with self._lock:
-            return self._objects.pop(object_id, None)
+            return self._objects.pop(object_id, default)
 
     def size(self) -> int:
         with self._lock:

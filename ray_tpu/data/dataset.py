@@ -155,6 +155,15 @@ def _exec_stream(plan: List[Any]) -> Iterator[Any]:
     else:
         stream = (ray_tpu.put(b) for b in src.make_blocks())
 
+    # Per-execution resource manager: reservation-based op budgets the
+    # backpressure chain consults via the per-op binding register_ops
+    # makes (planner.ReservationBackpressurePolicy; reference:
+    # _internal/execution/resource_manager.py).
+    from ray_tpu.data.planner import ResourceManager
+
+    rm = ResourceManager()
+    rm.register_ops(plan[1:])
+
     for op in plan[1:]:
         if isinstance(op, _MapBatchesActor):
             stream = _actor_map_stream(op, stream)
@@ -170,17 +179,28 @@ def _map_stream(op: _MapBatches, upstream: Iterator[Any]) -> Iterator[Any]:
     def _run(block: Block, op=op) -> Block:
         return _apply_map_batches(op, block)
 
-    from ray_tpu.data.planner import effective_window
+    from ray_tpu.data.planner import (
+        current_resource_manager, effective_window,
+    )
 
     remote = _run.options(num_cpus=op.num_cpus)
+    rm = getattr(op, "_rt_resource_manager", None) or \
+        current_resource_manager()
     inflight: "deque[Any]" = deque()
     for ref in upstream:
         inflight.append(remote.remote(ref))
+        if rm is not None:
+            rm.on_launch(op)
         # Backpressure policies re-evaluated per block: a full object
-        # store shrinks the window to drain mode mid-stream.
+        # store shrinks the window to drain mode mid-stream; the
+        # reservation policy bounds this op's share of execution CPU.
         if len(inflight) >= effective_window(op):
+            if rm is not None:
+                rm.on_complete(op)
             yield inflight.popleft()
     while inflight:
+        if rm is not None:
+            rm.on_complete(op)
         yield inflight.popleft()
 
 

@@ -12,7 +12,7 @@ Dataset can carry its own via `with_rules` (see dataset.py).
 
 from __future__ import annotations
 
-from typing import Any, List
+from typing import Any, Dict, List, Optional
 
 from ray_tpu.utils.logging import get_logger
 
@@ -147,3 +147,141 @@ def effective_window(op: Any) -> int:
         except Exception:  # noqa: BLE001
             continue
     return window
+
+
+# ---------------------------------------------------------------------------
+# Execution resource manager (reference: python/ray/data/_internal/
+# execution/resource_manager.py — ResourceManager + the reservation-based
+# ReservationOpResourceAllocator: a global execution budget is split into
+# per-operator reservations plus a shared pool, and operator concurrency
+# is bounded by what its reservation can hold).
+# ---------------------------------------------------------------------------
+
+class ExecutionBudget:
+    """Global budget one dataset execution may consume."""
+
+    def __init__(self, cpu_slots: Optional[float] = None,
+                 store_bytes: Optional[int] = None):
+        if cpu_slots is None:
+            import os
+
+            cpu_slots = float(os.cpu_count() or 1)
+        self.cpu_slots = cpu_slots
+        self.store_bytes = store_bytes
+
+
+class ResourceManager:
+    """Per-execution reservations over the global budget.
+
+    Each operator gets `reservation_frac / n_ops` of the budget
+    exclusively; the rest is a shared pool ops borrow from first-come.
+    An op's launch window is what its reservation + current shared
+    borrow can hold, in units of its per-task cost (cpu) — shrink-only
+    against the configured window, like every backpressure policy."""
+
+    def __init__(self, budget: Optional[ExecutionBudget] = None,
+                 reservation_frac: float = 0.5):
+        self.budget = budget or ExecutionBudget()
+        self.reservation_frac = reservation_frac
+        self._ops: Dict[int, Dict[str, Any]] = {}
+
+    # -- registration ---------------------------------------------------
+    def register_ops(self, ops) -> None:
+        self._ops.clear()
+        for op in ops:
+            self._ops[id(op)] = {
+                "op": op,
+                "inflight": 0,
+                "cpu_per_task": max(0.001,
+                                    float(getattr(op, "num_cpus", 1.0))),
+            }
+            # Bind manager→op directly: the reservation policy reads this,
+            # so two interleaved dataset executions each keep their own
+            # budgets (a process-global contextvar would make the second
+            # execution silently unbound the first's ops).
+            try:
+                op._rt_resource_manager = self
+            except Exception:  # slotted/frozen op: falls back to contextvar
+                pass
+
+    def _reserved_slots(self) -> float:
+        n = max(1, len(self._ops))
+        return self.budget.cpu_slots * self.reservation_frac / n
+
+    def _shared_pool_free(self) -> float:
+        shared = self.budget.cpu_slots * (1.0 - self.reservation_frac)
+        borrowed = 0.0
+        for st in self._ops.values():
+            over = (st["inflight"] * st["cpu_per_task"]
+                    - self._reserved_slots())
+            if over > 0:
+                borrowed += over
+        return max(0.0, shared - borrowed)
+
+    # -- accounting (executor hooks) -----------------------------------
+    def on_launch(self, op) -> None:
+        st = self._ops.get(id(op))
+        if st is not None:
+            st["inflight"] += 1
+
+    def on_complete(self, op) -> None:
+        st = self._ops.get(id(op))
+        if st is not None and st["inflight"] > 0:
+            st["inflight"] -= 1
+
+    # -- the bound ------------------------------------------------------
+    def max_inflight(self, op) -> int:
+        st = self._ops.get(id(op))
+        if st is None:
+            return 10**9  # unregistered op: no reservation bound
+        per_task = st["cpu_per_task"]
+        own = self._reserved_slots() / per_task
+        shared = self._shared_pool_free() / per_task
+        return max(1, int(own + shared))
+
+    def usage(self) -> Dict[str, Any]:
+        return {
+            "ops": {getattr(st["op"], "name", repr(st["op"])):
+                    {"inflight": st["inflight"],
+                     "cpu_per_task": st["cpu_per_task"]}
+                    for st in self._ops.values()},
+            "cpu_slots": self.budget.cpu_slots,
+            "reserved_per_op": self._reserved_slots(),
+            "shared_free": self._shared_pool_free(),
+        }
+
+
+# The manager for the currently-executing dataset plan (set by the
+# streaming executor around a plan run; consulted by the policy below).
+import contextvars as _contextvars
+
+_current_rm: "_contextvars.ContextVar[Optional[ResourceManager]]" = \
+    _contextvars.ContextVar("ray_tpu_data_rm", default=None)
+
+
+def set_resource_manager(rm: Optional[ResourceManager]):
+    return _current_rm.set(rm)
+
+
+def current_resource_manager() -> Optional[ResourceManager]:
+    return _current_rm.get()
+
+
+class ReservationBackpressurePolicy(BackpressurePolicy):
+    """Bound each op by its reservation in its execution's
+    ResourceManager (reference: ReservationOpResourceAllocator
+    max_task_output_bytes_to_read / can_submit gating). The manager is
+    bound per-op at register_ops time; the contextvar is an explicit
+    scoping hook for tests/embedders, not set by the executor."""
+
+    name = "reservation"
+
+    def max_inflight(self, op: Any) -> int:
+        rm = (getattr(op, "_rt_resource_manager", None)
+              or current_resource_manager())
+        if rm is None:
+            return 10**9
+        return rm.max_inflight(op)
+
+
+_BP_POLICIES.append(ReservationBackpressurePolicy())

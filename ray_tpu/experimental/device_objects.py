@@ -514,8 +514,12 @@ async def _fetch_async(worker, value: DeviceObjectValue) -> List[Any]:
 
 
 def _to_local_device(host_array) -> Any:
-    import jax
-
+    try:
+        import jax
+    except Exception:
+        # jax-less consumer (e.g. a numpy rank sharing a collective round
+        # with device ranks): deliver the host-staged array as-is.
+        return host_array
     return jax.device_put(host_array)
 
 

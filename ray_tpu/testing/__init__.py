@@ -1,0 +1,26 @@
+"""ray_tpu.testing — the systematic fake layer (SURVEY C27).
+
+Reference counterpart: the hand-written gmock headers mirroring every
+interface under `src/mock/ray/**` that let any C++ component be unit
+tested against scripted peers. This runtime's interfaces are framed-pickle
+RPC surfaces, so the TPU-native analog is a set of in-process fake
+*servers* speaking the real wire protocol (clients under test connect to
+them exactly as to production peers) plus a gmock-style scripting/spying
+wrapper over any handler.
+"""
+
+from ray_tpu.testing.fakes import (
+    FakeGcs,
+    FakeNodelet,
+    FakePeer,
+    RpcSpy,
+    serve_fake,
+)
+
+__all__ = [
+    "FakeGcs",
+    "FakeNodelet",
+    "FakePeer",
+    "RpcSpy",
+    "serve_fake",
+]

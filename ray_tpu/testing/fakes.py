@@ -1,0 +1,235 @@
+"""In-process fakes of the runtime's RPC surfaces (SURVEY C27 — the
+reference mirrors every C++ interface with a gmock header under
+`src/mock/ray/**`; here every interface is a framed-pickle RPC service,
+so a fake is a real `RpcServer` with scripted handlers: code under test
+connects over the actual wire protocol).
+
+Building blocks:
+- `RpcSpy` — gmock-style scripting for one method: queue replies, errors,
+  delays; records every call's kwargs.
+- `FakePeer` — an RpcServer on its own event-loop thread whose methods
+  are RpcSpies; `serve_fake()` starts it and returns the address.
+- `FakeGcs` / `FakeNodelet` — peers preloaded with the subset of GCS /
+  nodelet behavior most client-side units need (node table, KV,
+  lease grant/deny sequencing), still overridable per method.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_tpu._private.rpc import EventLoopThread, RpcServer
+
+
+class _Scripted:
+    __slots__ = ("value", "error", "delay_s")
+
+    def __init__(self, value=None, error=None, delay_s=0.0):
+        self.value = value
+        self.error = error
+        self.delay_s = delay_s
+
+
+class RpcSpy:
+    """Scriptable, recording handler for one RPC method.
+
+    Replies come from (in order): queued one-shot scripts (`then_*`),
+    the standing script (`always_*`), or the wrapped real handler.
+    Every call's kwargs are recorded in `.calls`.
+    """
+
+    def __init__(self, real: Optional[Callable] = None):
+        self._real = real
+        self._queue: List[_Scripted] = []
+        self._always: Optional[_Scripted] = None
+        self.calls: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    # -- scripting (gmock: EXPECT_CALL().WillOnce / WillRepeatedly) -----
+    def then_return(self, value, delay_s: float = 0.0) -> "RpcSpy":
+        with self._lock:
+            self._queue.append(_Scripted(value=value, delay_s=delay_s))
+        return self
+
+    def then_raise(self, error: BaseException,
+                   delay_s: float = 0.0) -> "RpcSpy":
+        with self._lock:
+            self._queue.append(_Scripted(error=error, delay_s=delay_s))
+        return self
+
+    def always_return(self, value, delay_s: float = 0.0) -> "RpcSpy":
+        self._always = _Scripted(value=value, delay_s=delay_s)
+        return self
+
+    def always_raise(self, error: BaseException) -> "RpcSpy":
+        self._always = _Scripted(error=error)
+        return self
+
+    # -- the handler ----------------------------------------------------
+    async def __call__(self, **kwargs):
+        with self._lock:
+            self.calls.append(kwargs)
+            script = self._queue.pop(0) if self._queue else self._always
+        if script is not None:
+            if script.delay_s:
+                await asyncio.sleep(script.delay_s)
+            if script.error is not None:
+                raise script.error
+            return script.value
+        if self._real is not None:
+            out = self._real(**kwargs)
+            if asyncio.iscoroutine(out):
+                return await out
+            return out
+        raise RuntimeError("RpcSpy has no script and no real handler")
+
+    @property
+    def call_count(self) -> int:
+        return len(self.calls)
+
+
+class FakePeer:
+    """An addressable fake service: every method is an RpcSpy.
+
+    `spy(name)` creates/returns the method's spy (registering it with the
+    live server), so tests can script before OR after serve_fake()."""
+
+    def __init__(self, **handlers: Callable):
+        self._spies: Dict[str, RpcSpy] = {
+            name: RpcSpy(fn) for name, fn in handlers.items()}
+        self._server: Optional[RpcServer] = None
+        self._loop_thread: Optional[EventLoopThread] = None
+        self.address: Optional[Tuple[str, int]] = None
+
+    def spy(self, method: str) -> RpcSpy:
+        sp = self._spies.get(method)
+        if sp is None:
+            sp = self._spies[method] = RpcSpy()
+            if self._server is not None:
+                self._server.register(method, sp)
+        return sp
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> Tuple[str, int]:
+        self._loop_thread = EventLoopThread("fake_peer")
+        self._server = RpcServer()
+        for name, sp in self._spies.items():
+            self._server.register(name, sp)
+        self.address = self._loop_thread.run(self._server.start())
+        return self.address
+
+    def stop(self) -> None:
+        if self._loop_thread is not None:
+            try:
+                self._loop_thread.run(self._server.stop())
+            except Exception:
+                pass
+            self._loop_thread.stop()
+            self._loop_thread = None
+
+
+def serve_fake(peer: FakePeer) -> Tuple[str, int]:
+    """Start a fake peer's server; returns (host, port)."""
+    return peer.start()
+
+
+class FakeGcs(FakePeer):
+    """Scripted GCS: in-memory node table + KV + recorded task events —
+    the accessor subset GCS clients exercise (reference:
+    mock/ray/gcs/gcs_client/gcs_client.h)."""
+
+    def __init__(self):
+        self.nodes: List[Dict[str, Any]] = []
+        self.kv: Dict[str, bytes] = {}
+        self.task_events: List[Dict[str, Any]] = []
+        super().__init__(
+            list_nodes=self._list_nodes,
+            register_node=self._register_node,
+            kv_put=self._kv_put,
+            kv_get=self._kv_get,
+            kv_del=self._kv_del,
+            report_task_events=self._report_task_events,
+            health_check=self._health_check,
+        )
+
+    def add_node(self, node_id: bytes, *, alive: bool = True,
+                 resources: Optional[Dict[str, float]] = None,
+                 **extra) -> Dict[str, Any]:
+        node = {"node_id": node_id, "alive": alive,
+                "resources_available": dict(resources or {"CPU": 1.0}),
+                "demand": [], **extra}
+        self.nodes.append(node)
+        return node
+
+    async def _list_nodes(self):
+        return list(self.nodes)
+
+    async def _register_node(self, **info):
+        self.nodes.append({"alive": True, **info})
+        return {"ok": True}
+
+    async def _kv_put(self, key: str, value, overwrite: bool = True):
+        if not overwrite and key in self.kv:
+            return False
+        self.kv[key] = value
+        return True
+
+    async def _kv_get(self, key: str):
+        return self.kv.get(key)
+
+    async def _kv_del(self, key: str):
+        return self.kv.pop(key, None) is not None
+
+    async def _report_task_events(self, events):
+        self.task_events.extend(events)
+
+    async def _health_check(self):
+        return {"ok": True}
+
+
+class FakeNodelet(FakePeer):
+    """Scripted nodelet: a lease book with explicit grant/deny control —
+    the surface lease clients (LeasePool et al.) negotiate against
+    (reference: mock/ray/raylet_client/raylet_client.h)."""
+
+    def __init__(self, *, capacity: int = 1):
+        self.capacity = capacity
+        self.leased: List[str] = []
+        self.returned: List[str] = []
+        self._next = 0
+        self._waiters: List[asyncio.Future] = []
+        super().__init__(
+            lease_worker=self._lease_worker,
+            return_worker=self._return_worker,
+            ping=self._ping,
+        )
+
+    def _grant(self) -> Dict[str, Any]:
+        self._next += 1
+        wid = f"fake-worker-{self._next}"
+        self.leased.append(wid)
+        return {"ok": True, "worker_id": wid,
+                "address": ["127.0.0.1", 1], "contended": False}
+
+    async def _lease_worker(self, block: bool = False, **kwargs):
+        if len(self.leased) - len(self.returned) < self.capacity:
+            return self._grant()
+        if not block:
+            return {"ok": False, "reason": "no capacity"}
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        await fut
+        return self._grant()
+
+    async def _return_worker(self, worker_id: str, **kwargs):
+        self.returned.append(worker_id)
+        if self._waiters:
+            fut = self._waiters.pop(0)
+            if not fut.done():
+                fut.set_result(None)
+        return {"ok": True}
+
+    async def _ping(self):
+        return "pong"

@@ -188,3 +188,36 @@ def test_broadcast_jax_rides_device_plane(ray_start_regular):
     outs = ray_tpu.get([m.do_broadcast.remote() for m in ms], timeout=180)
     for is_jax, total in outs:
         assert is_jax and total == 56.0
+
+
+def test_allreduce_mixed_numpy_and_jax_ranks(ray_start_regular):
+    """A numpy rank and jax ranks may legally share an allreduce round
+    (one round kind either way): the coordinator hands back the ordered
+    contributions and every rank reduces locally — no deadlock, exact
+    result on both kinds of rank."""
+
+    @ray_tpu.remote
+    class Mixed:
+        def __init__(self, rank, world):
+            self.rank = rank
+            self.group = col.init_collective_group(
+                world, rank, group_name="mixedred")
+
+        def go(self, use_jax):
+            if use_jax:
+                import jax.numpy as jnp
+
+                val = jnp.arange(8.0) * (self.rank + 1)
+            else:
+                val = np.arange(8.0) * (self.rank + 1)
+            out = self.group.allreduce(val)
+            return np.asarray(out)
+
+    world = 3
+    ms = [Mixed.remote(r, world) for r in range(world)]
+    # rank 0 is the numpy rank; 1..2 are device ranks
+    outs = ray_tpu.get(
+        [m.go.remote(r != 0) for r, m in enumerate(ms)], timeout=180)
+    expect = np.arange(8.0) * sum(range(1, world + 1))
+    for out in outs:
+        np.testing.assert_allclose(out, expect)

@@ -1,0 +1,155 @@
+"""The systematic fake layer (SURVEY C27 — reference: `src/mock/ray/**`
+gmock headers). These are true unit tests: real clients speak the real
+wire protocol to scripted in-process fakes; no cluster processes."""
+
+import asyncio
+import time
+
+import pytest
+
+from ray_tpu._private.rpc import (
+    EventLoopThread, RemoteError, RpcClient,
+)
+from ray_tpu.exceptions import GetTimeoutError  # noqa: F401 (api parity)
+from ray_tpu.testing import FakeGcs, FakeNodelet, FakePeer, serve_fake
+
+
+@pytest.fixture()
+def loop_thread():
+    lt = EventLoopThread("test_fakes")
+    yield lt
+    lt.stop()
+
+
+def test_spy_scripting_order_and_recording(loop_thread):
+    peer = FakePeer()
+    peer.spy("echo").then_return("first").then_raise(
+        RuntimeError("scripted")).always_return("steady")
+    host, port = serve_fake(peer)
+    client = RpcClient(host, port, name="t")
+
+    async def drive():
+        out = [await client.call("echo", x=1)]
+        try:
+            await client.call("echo", x=2)
+            out.append("no-error")
+        except RemoteError as e:
+            out.append(f"error:{'scripted' in str(e)}")
+        out.append(await client.call("echo", x=3))
+        out.append(await client.call("echo", x=4))
+        await client.close()
+        return out
+
+    try:
+        assert loop_thread.run(drive()) == [
+            "first", "error:True", "steady", "steady"]
+        assert [c["x"] for c in peer.spy("echo").calls] == [1, 2, 3, 4]
+    finally:
+        peer.stop()
+
+
+def test_client_concurrent_inflight_with_delays(loop_thread):
+    """The real RpcClient pipelines concurrent calls on one connection:
+    a slow scripted reply must not head-of-line block a fast one."""
+    peer = FakePeer()
+    peer.spy("slow").always_return("s", delay_s=0.5)
+    peer.spy("fast").always_return("f")
+    host, port = serve_fake(peer)
+    client = RpcClient(host, port, name="t")
+
+    async def drive():
+        t0 = time.perf_counter()
+        slow = asyncio.ensure_future(client.call("slow"))
+        fast = await client.call("fast")
+        fast_dt = time.perf_counter() - t0
+        out = await slow
+        await client.close()
+        return fast, fast_dt, out
+
+    try:
+        fast, fast_dt, slow = loop_thread.run(drive())
+        assert fast == "f" and slow == "s"
+        assert fast_dt < 0.4, f"fast call waited on slow: {fast_dt}"
+    finally:
+        peer.stop()
+
+
+def test_fake_gcs_tables_and_kv(loop_thread):
+    gcs = FakeGcs()
+    gcs.add_node(b"n1", resources={"CPU": 4.0})
+    gcs.add_node(b"n2", alive=False)
+    host, port = serve_fake(gcs)
+    client = RpcClient(host, port, name="gcs")
+
+    async def drive():
+        nodes = await client.call("list_nodes")
+        assert await client.call("kv_put", key="a", value=b"1")
+        first = await client.call(
+            "kv_put", key="a", value=b"2", overwrite=False)
+        got = await client.call("kv_get", key="a")
+        await client.call("report_task_events",
+                          events=[{"task_id": "t1"}])
+        await client.close()
+        return nodes, first, got
+
+    try:
+        nodes, first, got = loop_thread.run(drive())
+        assert [n["alive"] for n in nodes] == [True, False]
+        assert nodes[0]["resources_available"] == {"CPU": 4.0}
+        assert first is False and got == b"1"
+        assert gcs.task_events == [{"task_id": "t1"}]
+    finally:
+        gcs.stop()
+
+
+def test_fake_nodelet_lease_grant_deny_block(loop_thread):
+    """Lease-protocol sequencing against the scripted nodelet: capacity 1
+    grants once, denies non-blocking, parks a blocking request until a
+    return frees capacity — the exact negotiation LeasePool drives."""
+    nl = FakeNodelet(capacity=1)
+    host, port = serve_fake(nl)
+    client = RpcClient(host, port, name="nl")
+
+    async def drive():
+        g1 = await client.call("lease_worker", resources={"CPU": 1})
+        d = await client.call("lease_worker", resources={"CPU": 1})
+        blocked = asyncio.ensure_future(
+            client.call("lease_worker", resources={"CPU": 1}, block=True))
+        await asyncio.sleep(0.1)
+        assert not blocked.done(), "blocking lease must park"
+        await client.call("return_worker", worker_id=g1["worker_id"])
+        g2 = await asyncio.wait_for(blocked, 5)
+        await client.close()
+        return g1, d, g2
+
+    try:
+        g1, d, g2 = loop_thread.run(drive())
+        assert g1["ok"] and not d["ok"] and g2["ok"]
+        assert g2["worker_id"] != g1["worker_id"]
+        assert nl.returned == [g1["worker_id"]]
+    finally:
+        nl.stop()
+
+
+def test_spy_overrides_fake_behavior(loop_thread):
+    """Per-method override on a behavioral fake — the gmock pattern of
+    mocking one method of an otherwise-real object."""
+    nl = FakeNodelet(capacity=8)
+    nl.spy("lease_worker").then_raise(RuntimeError("injected outage"))
+    host, port = serve_fake(nl)
+    client = RpcClient(host, port, name="nl")
+
+    async def drive():
+        try:
+            await client.call("lease_worker")
+            first = "ok"
+        except RemoteError as e:
+            first = "outage" if "injected outage" in str(e) else "other"
+        second = (await client.call("lease_worker"))["ok"]
+        await client.close()
+        return first, second
+
+    try:
+        assert loop_thread.run(drive()) == ("outage", True)
+    finally:
+        nl.stop()
