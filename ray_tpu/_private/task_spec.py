@@ -124,6 +124,12 @@ class TaskSpec:
     # the user span active in the SUBMITTER, restored as the execution
     # side's parent so spans chain across process hops automatically.
     trace_parent: Optional[str] = None
+    # Lifecycle timestamps (reference: GcsTaskManager state timeline,
+    # task_event_buffer.h): stamped owner-side and shipped with the spec so
+    # the executor's task event carries the full SUBMITTED → LEASE_GRANTED
+    # → ARGS_READY → RUNNING → FINISHED breakdown on one wall clock hop.
+    submitted_ts: float = 0.0
+    lease_ts: float = 0.0
 
     def scheduling_key(self) -> Tuple:
         """Lease-reuse key (reference: SchedulingKey in

@@ -64,10 +64,62 @@ from ray_tpu.exceptions import (
     TaskCancelledError,
     WorkerCrashedError,
 )
+from ray_tpu.util import metrics as um
 from ray_tpu.utils.config import get_config
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+# Task-event buffer cap (reference: task_event_buffer.h's bounded buffer):
+# on sustained GCS unavailability old events are evicted oldest-first and
+# counted, instead of growing the requeue list without bound.
+_TASK_EVENT_BUFFER_MAX = int(
+    os.environ.get("RAY_TPU_TASK_EVENT_BUFFER_MAX", "10000"))
+
+
+# Runtime metric definitions — one site per metric (the registry dedupes by
+# name and silently ignores redefinitions, so inline duplicates would drift).
+def _m_tasks_submitted() -> "um.Counter":
+    return um.get_counter("ray_tpu_tasks_submitted_total",
+                          "Tasks submitted from this process")
+
+
+def _m_tasks_finished() -> "um.Counter":
+    return um.get_counter("ray_tpu_tasks_finished_total",
+                          "Tasks executed to completion on this node",
+                          tag_keys=("node", "name"))
+
+
+def _m_tasks_failed() -> "um.Counter":
+    return um.get_counter("ray_tpu_tasks_failed_total",
+                          "Tasks whose execution raised",
+                          tag_keys=("node", "name"))
+
+
+def _m_task_exec_hist() -> "um.Histogram":
+    return um.get_histogram("ray_tpu_task_exec_seconds",
+                            "User-code execution latency "
+                            "(args ready -> return)", tag_keys=("name",))
+
+
+def _m_task_e2e_hist() -> "um.Histogram":
+    return um.get_histogram("ray_tpu_task_e2e_seconds",
+                            "End-to-end task latency observed by the owner "
+                            "(submit -> completion)", tag_keys=("name",))
+
+
+def _m_events_dropped() -> "um.Counter":
+    return um.get_counter("ray_tpu_task_events_dropped_total",
+                          "Task events evicted from the bounded "
+                          "per-process buffer")
+
+
+def _m_lease_queue_gauge() -> "um.Gauge":
+    # Per-process series (pid tag): an idle executor's 0 must not shadow
+    # the driver's real backlog in the freshest-wins gauge merge.
+    return um.get_gauge("ray_tpu_lease_queue_depth",
+                        "Tasks queued in a process's lease pools awaiting "
+                        "a worker", tag_keys=("pid",))
 
 _global_worker: Optional["Worker"] = None
 _global_lock = threading.Lock()
@@ -380,6 +432,7 @@ class LeasePool:
                 pass
             if not self.queue.empty():
                 self.maybe_scale_up()
+            self.worker._update_lease_queue_gauge()
 
 
 class ActorSubmitter:
@@ -445,6 +498,11 @@ class ActorSubmitter:
                     break
             if not batch:
                 continue
+            now = time.time()
+            for spec, _, _ in batch:
+                # Actor tasks skip leasing; stamp dispatch time so the
+                # lifecycle breakdown still covers the submitter queue.
+                spec.lease_ts = now
             try:
                 client = await self._ensure_client()
                 # Long-running pinned loops (compiled-DAG channels) must
@@ -776,6 +834,20 @@ class Worker:
         self.loop_thread.run(_setup())
         self.connected = True
         set_global_worker(self)
+        self._preregister_metrics()
+
+    def _preregister_metrics(self) -> None:
+        """Create this process's runtime metrics up front (Prometheus
+        practice: series should exist at zero before first activity, so
+        dashboards and the live metrics-contract test see every promised
+        name as soon as the process joins the cluster)."""
+        _m_tasks_submitted()
+        _m_tasks_finished()
+        _m_tasks_failed()
+        _m_events_dropped().inc(0)
+        _m_task_exec_hist()
+        _m_task_e2e_hist()
+        _m_lease_queue_gauge().set(0.0, tags={"pid": str(os.getpid())})
 
     async def nodelet_client_for_node(self, node_id: bytes) -> RpcClient:
         """Cached RPC client to any node's nodelet (for spillback / PG /
@@ -946,18 +1018,39 @@ class Worker:
     def record_event(self, event: Dict[str, Any]) -> None:
         """Append one event to the task-event buffer and make sure the
         flusher runs. Used by task execution AND user tracing spans
-        (util/tracing.py) — the single entry point to the pipeline."""
+        (util/tracing.py) — the single entry point to the pipeline.
+        The buffer is bounded: oldest events are dropped (and counted)
+        rather than growing without limit while the GCS is unreachable."""
         event.setdefault("pid", os.getpid())
         event.setdefault("node_id", self.node_id.hex())
+        dropped = 0
         with self._task_events_lock:
             self._task_events.append(event)
+            overflow = len(self._task_events) - _TASK_EVENT_BUFFER_MAX
+            if overflow > 0:
+                del self._task_events[:overflow]
+                dropped = overflow
             if not self._task_events_flusher_started:
                 self._task_events_flusher_started = True
                 self.loop.call_soon_threadsafe(
                     lambda: asyncio.ensure_future(self._task_event_loop()))
+        if dropped:
+            self._count_dropped_events(dropped)
+
+    def _observe_task_done(self, spec: TaskSpec) -> None:
+        """Owner-side end-to-end latency (submit -> result landed)."""
+        if not spec.submitted_ts:
+            return
+        _m_task_e2e_hist().observe(time.time() - spec.submitted_ts,
+                                   tags={"name": spec.function_name})
+
+    @staticmethod
+    def _count_dropped_events(n: int) -> None:
+        _m_events_dropped().inc(n)
 
     def record_task_event(self, spec: TaskSpec, start_ts: float,
-                          end_ts: float, ok: bool) -> None:
+                          end_ts: float, ok: bool,
+                          args_ready_ts: Optional[float] = None) -> None:
         event = {
             "task_id": spec.task_id.hex(),
             "name": spec.function_name,
@@ -966,9 +1059,38 @@ class Worker:
             "end_ts": end_ts,
             "ok": ok,
         }
+        # Lifecycle breakdown (SUBMITTED → LEASE_GRANTED → ARGS_READY →
+        # RUNNING → FINISHED): owner-side stamps ride the spec, execution
+        # stamps are ours. state.task_latency_breakdown() aggregates these.
+        if spec.submitted_ts:
+            event["submitted_ts"] = spec.submitted_ts
+        if spec.lease_ts:
+            event["lease_ts"] = spec.lease_ts
+        if args_ready_ts:
+            event["args_ready_ts"] = args_ready_ts
         if spec.trace_parent:
             event["parent"] = spec.trace_parent
         self.record_event(event)
+        # Same "node" vocabulary as the nodelet's metrics (node_name, which
+        # defaults to the id prefix): PromQL joins/group-bys across metric
+        # families must match. Executors carry it in their spawn env.
+        node = (os.environ.get("RAY_TPU_NODE_NAME")
+                or self.node_id.hex()[:8])
+        counter = _m_tasks_finished() if ok else _m_tasks_failed()
+        counter.inc(tags={"node": node, "name": spec.function_name})
+        if args_ready_ts is not None:
+            # Only when user code actually ran: a failed arg fetch has no
+            # exec phase, and charging fetch time here would corrupt the
+            # exec-latency panel.
+            _m_task_exec_hist().observe(end_ts - args_ready_ts,
+                                        tags={"name": spec.function_name})
+        if spec.trace_parent:
+            # Stitched traces: runtime phases as spans chained under this
+            # task's row (which itself parents to the driver-side span).
+            from ray_tpu.util import tracing
+
+            tracing.emit_runtime_spans(self, spec, start_ts, args_ready_ts,
+                                       end_ts)
 
     async def _task_event_loop(self) -> None:
         while not self._shutdown:
@@ -977,12 +1099,24 @@ class Worker:
                 events, self._task_events = self._task_events, []
             if not events:
                 continue
+            t0 = time.monotonic()
             try:
                 await self.gcs_client.call("report_task_events",
                                            events=events)
             except Exception:
+                dropped = 0
                 with self._task_events_lock:
-                    self._task_events = events + self._task_events
+                    requeued = events + self._task_events
+                    overflow = len(requeued) - _TASK_EVENT_BUFFER_MAX
+                    if overflow > 0:
+                        requeued = requeued[overflow:]
+                        dropped = overflow
+                    self._task_events = requeued
+                if dropped:
+                    self._count_dropped_events(dropped)
+            else:
+                um.telemetry_flush_histogram().observe(
+                    time.monotonic() - t0, tags={"pipeline": "task_events"})
 
     @property
     def spill_dir(self) -> str:
@@ -1674,7 +1808,9 @@ class Worker:
                                               self._gcs_call_sync),
             label_selector=label_selector,
             trace_parent=_current_trace_parent(),
+            submitted_ts=time.time(),
         )
+        _m_tasks_submitted().inc()
         return_ids = self.task_manager.add_pending(spec)
         if num_returns == -1:
             from ray_tpu._private.generators import ObjectRefGenerator
@@ -1733,6 +1869,15 @@ class Worker:
                     touched.append(pool)
         for pool in touched:
             pool.maybe_scale_up()
+        self._update_lease_queue_gauge()
+
+    def _update_lease_queue_gauge(self) -> None:
+        """Submitter-side backlog awaiting a worker lease (runs on the loop
+        thread at submit waves and lease-pump exits — cheap sum of qsizes)."""
+        _m_lease_queue_gauge().set(
+            float(sum(p.queue.qsize()
+                      for p in self._lease_pools.values())),
+            tags={"pid": str(os.getpid())})
 
     def _next_spread_node(self) -> Optional[bytes]:
         """Round-robin over the cached alive-node list (refreshed every 1s
@@ -1877,7 +2022,9 @@ class Worker:
         specs are retried or failed permanently, mirroring push_task_to."""
         if len(specs) == 1:
             return await self.push_task_to(client, addr, specs[0])
+        now = time.time()
         for spec in specs:
+            spec.lease_ts = now  # LEASE_GRANTED: a leased worker took it
             self.task_manager.mark_inflight(spec.task_id, addr)
         try:
             reply = await client.call(
@@ -1912,6 +2059,7 @@ class Worker:
                            spec: TaskSpec) -> bool:
         """Push one task to a leased worker. Returns False when the worker is
         unusable (connection lost) so the caller drops the lease."""
+        spec.lease_ts = time.time()  # LEASE_GRANTED: a leased worker took it
         self.task_manager.mark_inflight(spec.task_id, addr)
         try:
             reply = await client.call("push_task", spec=spec,
@@ -1963,6 +2111,7 @@ class Worker:
             else:
                 return False
         self.task_manager.complete(spec.task_id, results)
+        self._observe_task_done(spec)
         return True
 
     async def handle_task_reply(self, spec: TaskSpec, reply: Dict[str, Any]) -> None:
@@ -2021,6 +2170,7 @@ class Worker:
             st.count = reply["generator_count"]
             st.pulse()
             self.task_manager.complete(spec.task_id, [])
+            self._observe_task_done(spec)
             return
         results = []
         for item in reply["results"]:
@@ -2041,6 +2191,7 @@ class Worker:
                         return
                 results.append(err_obj)
         self.task_manager.complete(spec.task_id, results)
+        self._observe_task_done(spec)
 
     # ------------------------------------------------------------------
     # Submission: actors
@@ -2087,6 +2238,7 @@ class Worker:
                                               self._gcs_call_sync),
             label_selector=label_selector,
             trace_parent=_current_trace_parent(),
+            submitted_ts=time.time(),
         )
         register = self.gcs_client.call_retrying(
             "register_actor",
@@ -2165,7 +2317,9 @@ class Worker:
             concurrency_group=concurrency_group,
             tensor_transport=tensor_transport,
             trace_parent=_current_trace_parent(),
+            submitted_ts=time.time(),
         )
+        _m_tasks_submitted().inc()
         return_ids = self.task_manager.add_pending(spec)
         if num_returns == -1:
             from ray_tpu._private.generators import ObjectRefGenerator
@@ -2554,11 +2708,13 @@ class Worker:
     def _execute_actor_task_sync(self, spec: TaskSpec, method: Any) -> Dict[str, Any]:
         t0 = time.time()
         ok = True
+        args_ready_ts = None
         trace_tok = _enter_trace_context(spec)
         try:
             texec = (time.perf_counter_ns()
                      if os.environ.get("RAY_TPU_PUSH_TRACE") else 0)
             args, kwargs = self._resolve_spec_args_sync(spec)
+            args_ready_ts = time.time()
             self._current_task_id = spec.task_id
             result = method(*args, **kwargs)
             if spec.num_returns == -1:
@@ -2574,7 +2730,7 @@ class Worker:
         finally:
             self._current_task_id = None
             _exit_trace_context(trace_tok)
-            self.record_task_event(spec, t0, time.time(), ok)
+            self.record_task_event(spec, t0, time.time(), ok, args_ready_ts)
 
     def _execute_task_sync(self, spec: TaskSpec) -> Dict[str, Any]:
         if spec.task_id in self._cancelled_tasks:
@@ -2582,10 +2738,12 @@ class Worker:
             return {"cancelled": True, "results": []}
         t0 = time.time()
         ok = True
+        args_ready_ts = None
         trace_tok = _enter_trace_context(spec)
         try:
             fn = self.function_manager.fetch(spec.function_key)
             args, kwargs = self._resolve_spec_args_sync(spec)
+            args_ready_ts = time.time()
             self._current_task_id = spec.task_id
             result = fn(*args, **kwargs)
             if spec.num_returns == -1:
@@ -2598,7 +2756,7 @@ class Worker:
         finally:
             self._current_task_id = None
             _exit_trace_context(trace_tok)
-            self.record_task_event(spec, t0, time.time(), ok)
+            self.record_task_event(spec, t0, time.time(), ok, args_ready_ts)
 
     def _spec_arg_ref_ids(self, spec: TaskSpec) -> List[ObjectID]:
         """ObjectIDs referenced by this task's args (direct ref args and

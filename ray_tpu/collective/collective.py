@@ -218,12 +218,27 @@ class CollectiveGroup:
             time.sleep(delay)
             delay = min(delay * 2, 0.05)
 
+    def _observe_round(self, kind: str, seconds: float) -> None:
+        """Collective round latency (contribute -> result visible at this
+        rank) — the out-of-program control-path collectives' share of step
+        time, next to the submit/exec histograms in the same /metrics."""
+        from ray_tpu.util import metrics as um
+
+        um.get_histogram(
+            "ray_tpu_collective_round_seconds",
+            "Collective round latency per kind (contribute -> collected)",
+            tag_keys=("group", "kind"),
+        ).observe(seconds, tags={"group": self.name, "kind": kind})
+
     def _run_round(self, kind: str, value: Any, op: str = "sum",
                    timeout: Optional[float] = 300.0) -> Any:
         key = self._next_key(kind)
+        t0 = time.monotonic()
         ray_tpu.get(self._coord.contribute.remote(key, self.rank, value, op))
-        return self._poll(lambda: self._coord.collect.remote(key),
-                          kind, key, timeout)
+        out = self._poll(lambda: self._coord.collect.remote(key),
+                         kind, key, timeout)
+        self._observe_round(kind, time.monotonic() - t0)
+        return out
 
     # -- API (reference: collective.py allreduce:295, reduce:358,
     #    broadcast:391, allgather:425, reducescatter:431, send:560,
@@ -263,21 +278,27 @@ class CollectiveGroup:
         """Reduction delivered to dst_rank only; other ranks contribute and
         return None without waiting for the result."""
         key = self._next_key("reduce")
+        t0 = time.monotonic()
         ray_tpu.get(self._coord.contribute.remote(key, self.rank, value, op))
         if self.rank != dst_rank:
             return None
-        return self._poll(lambda: self._coord.collect.remote(key),
-                          "reduce", key, timeout)
+        out = self._poll(lambda: self._coord.collect.remote(key),
+                         "reduce", key, timeout)
+        self._observe_round("reduce", time.monotonic() - t0)
+        return out
 
     def reducescatter(self, value, op: str = "sum",
                       timeout: Optional[float] = 300.0):
         """Element-wise reduction of every rank's tensor, split along axis
         0: rank r receives the r-th slice."""
         key = self._next_key("reducescatter")
+        t0 = time.monotonic()
         ray_tpu.get(self._coord.contribute.remote(key, self.rank, value, op))
-        return self._poll(
+        out = self._poll(
             lambda: self._coord.collect_part.remote(key, self.rank),
             "reducescatter", key, timeout)
+        self._observe_round("reducescatter", time.monotonic() - t0)
+        return out
 
     def allgather(self, value) -> List[Any]:
         if _takes_device_path(value):

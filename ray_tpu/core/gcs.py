@@ -263,6 +263,8 @@ class GcsServer:
         from collections import deque
 
         self.task_events: "deque" = deque(maxlen=20_000)
+        # Last-write times of live metrics:* snapshots (hygiene scan input).
+        self._metrics_seen: Dict[str, float] = {}
         self.storage = GcsStorage(persist_path)
         # Durable export-event files for external ingestion (reference:
         # src/ray/util/event.h + export_*.proto; gated by config).
@@ -309,7 +311,11 @@ class GcsServer:
 
     def _snapshot_tables(self) -> Dict[str, Any]:
         return {
-            "kv": dict(self.kv),
+            # metrics:* snapshots are live telemetry from (possibly dead)
+            # processes — persisting them would resurrect stale counters
+            # after a GCS restart and inflate every merged total.
+            "kv": {k: v for k, v in self.kv.items()
+                   if not k.startswith("metrics:")},
             "jobs": {str(k): v for k, v in self.jobs.items()},
             "job_counter": self._job_counter,
             "named_actors": {n: a.binary()
@@ -343,8 +349,126 @@ class GcsServer:
         if self.storage.path:
             self._background.append(
                 asyncio.ensure_future(self._persist_loop()))
+        # Metrics: the GCS IS the KV store, so its registry flushes write
+        # straight into the table (no RPC, no Worker). The write hops onto
+        # the event loop — GCS tables are loop-thread-owned, and a direct
+        # insert from the flusher thread would race _snapshot_tables'
+        # iteration ("dictionary changed size during iteration").
+        from ray_tpu.util import metrics as um
+
+        loop = asyncio.get_running_loop()
+        um.set_flush_sink(lambda key, payload: loop.call_soon_threadsafe(
+            self._metrics_kv_put, key, payload))
+        self._background.append(asyncio.ensure_future(self._metrics_loop()))
         logger.info("GCS listening on %s:%d", *addr)
         return addr
+
+    # Metric-snapshot hygiene (all on the loop thread). A process stale
+    # for METRICS_TTL_S has its snapshot RETIRED: gauges drop (stale by
+    # definition) while counters/histograms park under a per-origin
+    # `metrics:_retired:<origin>` key — counters must stay monotonic in
+    # /metrics, and keeping the parked copy per-origin means a process
+    # that merely lost connectivity supersedes it on its next flush
+    # instead of being double counted. Parked copies older than
+    # METRICS_RETIRE_FOLD_S fold into one accumulator key to bound growth;
+    # the fold gives up the supersede protection, so it waits a day — a
+    # process that reconnects after a >24h partition (and somehow outlived
+    # node health checks) may double count, a trade we accept to keep the
+    # key space bounded on high-churn clusters.
+    _RETIRED_PREFIX = "metrics:_retired:"
+    _RETIRED_ACCUM_KEY = "metrics:_retired:_accum"
+    METRICS_TTL_S = 600.0
+    METRICS_RETIRE_FOLD_S = 86400.0
+
+    def _metrics_kv_put(self, key: str, payload: bytes) -> None:
+        """Loop-thread insert of a live metrics snapshot: stamps the
+        last-write time (the TTL scan reads this instead of unpickling
+        every snapshot every round) and supersedes any parked retired
+        copy from the same origin."""
+        self.kv[key] = payload
+        self._metrics_seen[key] = time.time()
+        rkey = self._RETIRED_PREFIX + key[len("metrics:"):]
+        if self.kv.pop(rkey, None) is not None:
+            self._metrics_seen.pop(rkey, None)
+
+    async def _metrics_loop(self) -> None:
+        import pickle as _pickle
+
+        from ray_tpu.util import metrics as um
+
+        g_nodes = um.get_gauge("ray_tpu_nodes_alive",
+                               "Nodes currently registered and alive")
+        g_actors = um.get_gauge("ray_tpu_actors_alive",
+                                "Actors currently in the ALIVE state")
+        g_tasks = um.get_gauge(
+            "ray_tpu_task_events_stored",
+            "Task events retained in the GCS ring buffer")
+        while True:
+            try:
+                await asyncio.sleep(2.0)
+                g_nodes.set(sum(1 for n in self.nodes.values() if n.alive))
+                g_actors.set(sum(1 for a in self.actors.values()
+                                 if a.state == "ALIVE"))
+                g_tasks.set(float(len(self.task_events)))
+                now = time.time()
+                for key in [k for k in self.kv
+                            if k.startswith("metrics:")
+                            and not k.startswith(self._RETIRED_PREFIX)]:
+                    seen = self._metrics_seen.get(key)
+                    if seen is None:
+                        # First sighting (e.g. written before this loop
+                        # started): grace period begins now.
+                        self._metrics_seen[key] = now
+                        continue
+                    if now - seen <= self.METRICS_TTL_S:
+                        continue
+                    try:
+                        snaps = [s for s in _pickle.loads(bytes(self.kv[key]))
+                                 if s.get("kind") in ("counter", "histogram")]
+                    except Exception:
+                        # Not a telemetry snapshot (foreign data under the
+                        # metrics: prefix): never delete what we can't read
+                        # — and re-stamp so we only retry once per TTL, not
+                        # every 2s round.
+                        self._metrics_seen[key] = now
+                        continue
+                    self.kv.pop(key, None)
+                    self._metrics_seen.pop(key, None)
+                    if snaps:
+                        rkey = self._RETIRED_PREFIX + key[len("metrics:"):]
+                        self.kv[rkey] = _pickle.dumps(snaps, protocol=5)
+                        self._metrics_seen[rkey] = now
+                # Fold long-retired parked copies into the accumulator.
+                expired: List[Dict[str, Any]] = []
+                for key in [k for k in self.kv
+                            if k.startswith(self._RETIRED_PREFIX)
+                            and k != self._RETIRED_ACCUM_KEY]:
+                    seen = self._metrics_seen.setdefault(key, now)
+                    if now - seen <= self.METRICS_RETIRE_FOLD_S:
+                        continue
+                    try:
+                        expired.extend(_pickle.loads(bytes(self.kv[key])))
+                    except Exception:
+                        pass
+                    self.kv.pop(key, None)
+                    self._metrics_seen.pop(key, None)
+                if expired:
+                    merged: Dict[str, Any] = {}
+                    fresh: Dict[Any, float] = {}
+                    cur = self.kv.get(self._RETIRED_ACCUM_KEY)
+                    if cur:
+                        um.merge_snapshot(merged, fresh,
+                                          _pickle.loads(bytes(cur)))
+                    um.merge_snapshot(merged, fresh, expired)
+                    self.kv[self._RETIRED_ACCUM_KEY] = _pickle.dumps(
+                        [{"name": name, "kind": m["kind"],
+                          "description": m["description"],
+                          "values": m["values"], "ts": now}
+                         for name, m in merged.items()], protocol=5)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                pass  # telemetry must never hurt the control plane
 
     async def stop(self) -> None:
         for t in self._background:
@@ -489,8 +613,14 @@ class GcsServer:
         existed = key in self.kv
         if existed and not overwrite:
             return True
-        self.kv[key] = value
-        self.mark_dirty()
+        # metrics:* snapshots arrive every ~2s from every process and are
+        # excluded from the persisted snapshot — marking dirty for them
+        # would rewrite an unchanged store to disk forever on idle clusters.
+        if key.startswith("metrics:"):
+            self._metrics_kv_put(key, value)
+        else:
+            self.kv[key] = value
+            self.mark_dirty()
         return existed
 
     async def rpc_kv_cas(self, key: str, expect: Optional[bytes],

@@ -29,10 +29,32 @@ from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.rpc import RpcClient, RpcServer
 from ray_tpu._private.task_spec import ResourceSet
 from ray_tpu.core.object_store import SharedMemoryStore
+from ray_tpu.util import metrics as um
 from ray_tpu.utils.config import get_config
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+# Lease-path metric definitions — one site per metric (the registry dedupes
+# by name; a second inline definition would silently drift).
+def _m_leases_granted() -> "um.Counter":
+    return um.get_counter("ray_tpu_leases_granted_total",
+                          "Worker leases granted by this nodelet",
+                          tag_keys=("node",))
+
+
+def _m_leases_queued() -> "um.Counter":
+    return um.get_counter("ray_tpu_leases_queued_total",
+                          "Lease requests that had to wait for resources",
+                          tag_keys=("node",))
+
+
+def _m_sched_latency() -> "um.Histogram":
+    return um.get_histogram(
+        "ray_tpu_scheduling_latency_seconds",
+        "Lease request arrival -> worker grant on this nodelet",
+        tag_keys=("node",))
 
 
 def _sweep_dead_arenas(shm_dir: str = "/dev/shm") -> int:
@@ -260,6 +282,18 @@ class Nodelet:
         self._background.append(
             asyncio.ensure_future(self._memory_monitor_loop()))
         self._background.append(asyncio.ensure_future(self._log_monitor_loop()))
+        # Metrics: this process has no Worker, so route registry flushes
+        # through our own GCS client; the sampler loop feeds the per-node
+        # gauges the Grafana cluster dashboard promises.
+        loop = asyncio.get_running_loop()
+
+        def _metrics_sink(key: str, payload: bytes) -> None:
+            asyncio.run_coroutine_threadsafe(
+                self._gcs.call("kv_put", key=key, value=payload), loop,
+            ).result(timeout=10)
+
+        um.set_flush_sink(_metrics_sink)
+        self._background.append(asyncio.ensure_future(self._metrics_loop()))
         logger.info("nodelet %s on %s:%d resources=%s", self.node_name, *addr,
                     self.resources_total)
         return addr
@@ -639,6 +673,8 @@ class Nodelet:
         env_key = repr(sorted((runtime_env or {}).items())) + (
             "|tpu" if needs_tpu else "")
         cfg = get_config()
+        t_req = time.monotonic()
+        queued_counted = False
         deadline = time.monotonic() + cfg.worker_start_timeout_s
         while True:
             pool = self._bundle_pool(pg_bundle)
@@ -678,6 +714,9 @@ class Nodelet:
                         int(mem))
                 worker.pg_bundle = pg_bundle
                 worker.tpu_chips = chips if num_tpus >= 1 else []
+                _m_leases_granted().inc(tags={"node": self.node_name})
+                _m_sched_latency().observe(time.monotonic() - t_req,
+                                           tags={"node": self.node_name})
                 return {
                     "ok": True,
                     "worker_id": worker.worker_id.binary(),
@@ -690,6 +729,9 @@ class Nodelet:
                     # worker-starved node).
                     "contended": bool(self._lease_waiters),
                 }
+            if not queued_counted:
+                queued_counted = True
+                _m_leases_queued().inc(tags={"node": self.node_name})
             if not block:
                 if pg_bundle is None:
                     # PG-bundle leases are pinned to this node; a new node
@@ -1097,6 +1139,83 @@ class Nodelet:
             if ts < cutoff:
                 del self._unmet_demand[key]
         return [shape for shape, _ in self._unmet_demand.values()]
+
+    async def _metrics_loop(self) -> None:
+        """Per-node runtime gauges (reference: the reporter agent's psutil
+        sampling -> OpenCensus gauges): resource availability, leased
+        workers, object-store usage, and per-worker RSS. Labelled gauges
+        are cleared each round so series for dead workers don't linger."""
+        node = self.node_name
+        g_avail = um.get_gauge(
+            "ray_tpu_resource_available",
+            "Schedulable capacity currently available on the node",
+            tag_keys=("node", "resource"))
+        g_leased = um.get_gauge(
+            "ray_tpu_workers_leased",
+            "Worker processes currently leased out on the node",
+            tag_keys=("node",))
+        g_workers = um.get_gauge(
+            "ray_tpu_workers_alive",
+            "Worker processes alive in the node's pool",
+            tag_keys=("node",))
+        g_store = um.get_gauge(
+            "ray_tpu_object_store_bytes_in_use",
+            "Bytes resident in the node's shared-memory object store",
+            tag_keys=("node",))
+        g_rss = um.get_gauge(
+            "ray_tpu_worker_rss_mb",
+            "Resident set size of each live worker process (MiB)",
+            tag_keys=("node", "worker"))
+        # Pre-register the node's counters/histograms at zero so every
+        # dashboard-promised series exists from node start, not from the
+        # first lease / first spill.
+        from ray_tpu.core.object_store import (
+            _arena_puts_counter,
+            _spilled_bytes_counter,
+            _spilled_objects_counter,
+        )
+
+        _m_leases_granted().inc(0, tags={"node": node})
+        _m_leases_queued().inc(0, tags={"node": node})
+        _m_sched_latency()
+        _spilled_objects_counter().inc(0)
+        _spilled_bytes_counter().inc(0)
+        _arena_puts_counter()
+        page = os.sysconf("SC_PAGE_SIZE")
+        while not self._shutting_down:
+            await asyncio.sleep(2.0)
+            try:
+                g_avail.set_many(
+                    [({"node": node, "resource": res}, v)
+                     for res, v in dict(self.resources_available).items()])
+                live = [(wid, w) for wid, w in list(self.workers.items())
+                        if w.proc.poll() is None]
+                g_leased.set(sum(1 for _, w in live if w.leased),
+                             tags={"node": node})
+                g_workers.set(len(live), tags={"node": node})
+                try:
+                    g_store.set(
+                        float(self.store.stats().get("bytes_in_use", 0)),
+                        tags={"node": node})
+                except Exception:
+                    pass
+                rss_items = []
+                for wid, w in live:
+                    try:
+                        with open(f"/proc/{w.proc.pid}/statm") as f:
+                            rss_pages = int(f.read().split()[1])
+                    except (OSError, ValueError, IndexError):
+                        continue
+                    rss_items.append((
+                        {"node": node, "worker": wid.hex()[:12]},
+                        round(rss_pages * page / 2**20, 1)))
+                # Atomic replace: dead workers' series drop without a
+                # clear-then-set window a concurrent flush could snapshot.
+                g_rss.set_many(rss_items)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                pass  # sampling must never hurt the node
 
     async def _heartbeat_loop(self) -> None:
         cfg = get_config()

@@ -34,6 +34,32 @@ SHM_ERR_NOT_FOUND = -2
 SHM_ERR_FULL = -3
 
 
+def _arena_puts_counter():
+    """Arena put outcomes — hit rate = hit / (hit + full). Lazy import:
+    the metrics registry must not join this module's import chain (worker
+    imports the store before the util package finishes initializing)."""
+    from ray_tpu.util import metrics as um
+
+    return um.get_counter(
+        "ray_tpu_object_store_arena_puts_total",
+        "Shared-memory arena put attempts by outcome (hit|full)",
+        tag_keys=("result",))
+
+
+def _spilled_objects_counter():
+    from ray_tpu.util import metrics as um
+
+    return um.get_counter("ray_tpu_object_store_spilled_objects_total",
+                          "Objects spilled from the arena to disk")
+
+
+def _spilled_bytes_counter():
+    from ray_tpu.util import metrics as um
+
+    return um.get_counter("ray_tpu_object_store_spilled_bytes_total",
+                          "Bytes spilled from the arena to disk")
+
+
 def _load_native():
     from ray_tpu.native import build_library
 
@@ -149,11 +175,13 @@ class SharedMemoryStore:
         if rc == SHM_ERR_EXISTS:
             return False
         if rc == SHM_ERR_FULL:
+            _arena_puts_counter().inc(tags={"result": "full"})
             raise ObjectStoreFullError(
                 f"object of {total} bytes does not fit in store {self.path}"
             )
         if rc != SHM_OK:
             raise OSError(f"shm create failed rc={rc}")
+        _arena_puts_counter().inc(tags={"result": "hit"})
         try:
             pos = off.value
             for part in payload_parts:
@@ -432,6 +460,8 @@ def spill_write(spill_dir: str, object_id: ObjectID,
             f.write(struct.pack(">Q", len(buf)))
             f.write(buf)
     os.replace(tmp, path)
+    _spilled_objects_counter().inc()
+    _spilled_bytes_counter().inc(float(obj.total_bytes()))
     return path
 
 

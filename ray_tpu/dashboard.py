@@ -11,6 +11,8 @@ serve proxy). Endpoints:
   /api/jobs               list_jobs()
   /api/placement_groups   list_placement_groups()
   /api/tasks              list_task_events
+  /api/tasks/breakdown    task_latency_breakdown()
+  /metrics                Prometheus text exposition
   /healthz
 """
 
@@ -142,6 +144,10 @@ class DashboardActor:
             "/api/jobs": state.list_jobs,
             "/api/placement_groups": state.list_placement_groups,
             "/api/tasks": state.list_tasks,
+            # Per-phase task latency aggregation (queue/lease/fetch/exec
+            # p50/p95/max per function) — the "where does submit-path
+            # latency go" surface (reference: GcsTaskManager summaries).
+            "/api/tasks/breakdown": state.task_latency_breakdown,
             # Reporter-agent surfaces (reference: dashboard/modules/
             # reporter/ — stack dumps + process stats per node).
             "/api/stacks": state.stack_dump,

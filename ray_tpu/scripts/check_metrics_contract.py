@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Metrics-contract lint: every metric name a shipped Grafana dashboard
+references must be emitted somewhere in ray_tpu/ runtime code.
+
+The dashboards under ray_tpu/dashboard_grafana/ are part of the public
+observability surface — a panel whose `expr` names a metric nothing emits
+renders forever-empty (exactly the bug this repo shipped with for five
+rounds). This check extracts every `ray_tpu_*` name from the dashboard
+`expr` fields, strips the Prometheus histogram series suffixes
+(_bucket/_sum/_count), and fails unless the base name appears as a string
+literal in some ray_tpu/*.py file.
+
+Run from anywhere: paths resolve relative to this file. Exit 0 = contract
+holds; exit 1 lists the orphaned names. Wired into CI (.github/workflows/
+ci.yml, `metrics-contract` job).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DASHBOARD_DIR = os.path.join(PKG_ROOT, "dashboard_grafana")
+
+_NAME_RE = re.compile(r"ray_tpu_[a-z0-9_]+")
+_HISTOGRAM_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def dashboard_metric_names() -> "dict[str, list[str]]":
+    """{metric_base_name: [dashboard files referencing it]} from every
+    `expr` field in every dashboard JSON."""
+    names: dict[str, list[str]] = {}
+    for fname in sorted(os.listdir(DASHBOARD_DIR)):
+        if not fname.endswith(".json"):
+            continue
+        with open(os.path.join(DASHBOARD_DIR, fname)) as f:
+            doc = json.load(f)
+        exprs: list[str] = []
+
+        def walk(node):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    if k == "expr" and isinstance(v, str):
+                        exprs.append(v)
+                    else:
+                        walk(v)
+            elif isinstance(node, list):
+                for item in node:
+                    walk(item)
+
+        walk(doc)
+        for expr in exprs:
+            for name in _NAME_RE.findall(expr):
+                for suffix in _HISTOGRAM_SUFFIXES:
+                    if name.endswith(suffix):
+                        name = name[: -len(suffix)]
+                        break
+                names.setdefault(name, [])
+                if fname not in names[name]:
+                    names[name].append(fname)
+    return names
+
+
+def emitted_names() -> "set[str]":
+    """Every ray_tpu_* string literal in the package's Python sources
+    (the registry keys metrics are created under)."""
+    found: set[str] = set()
+    for dirpath, dirnames, filenames in os.walk(PKG_ROOT):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "dashboard_grafana")]
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            if os.path.abspath(path) == os.path.abspath(__file__):
+                continue  # this linter's own examples must not satisfy it
+            try:
+                with open(path, encoding="utf-8", errors="replace") as f:
+                    found.update(_NAME_RE.findall(f.read()))
+            except OSError:
+                continue
+    return found
+
+
+def main() -> int:
+    promised = dashboard_metric_names()
+    if not promised:
+        print("check_metrics_contract: no dashboard metric names found "
+              f"under {DASHBOARD_DIR} — dashboards missing?")
+        return 1
+    emitted = emitted_names()
+    missing = {name: files for name, files in sorted(promised.items())
+               if name not in emitted}
+    if missing:
+        print("check_metrics_contract: dashboard panels reference metrics "
+              "that no ray_tpu/ code emits:")
+        for name, files in missing.items():
+            print(f"  {name}  (promised by: {', '.join(files)})")
+        print("Either emit the metric from the runtime or drop the panel.")
+        return 1
+    print(f"check_metrics_contract: OK — {len(promised)} dashboard metric "
+          "names all emitted by runtime code.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

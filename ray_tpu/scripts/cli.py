@@ -220,7 +220,10 @@ def _state_command(args) -> None:
         elif args.command == "jobs":
             out = state.list_jobs()
         elif args.command == "tasks":
-            out = state.list_tasks()
+            if getattr(args, "breakdown", False):
+                out = state.task_latency_breakdown()
+            else:
+                out = state.list_tasks()
         elif args.command == "timeline":
             out = {"written": state.timeline("timeline.json")}
         elif args.command == "memory":
@@ -287,6 +290,11 @@ def main() -> None:
                  "metrics", "stack", "proc-stats"):
         p = sub.add_parser(name)
         p.add_argument("--address")
+        if name == "tasks":
+            p.add_argument("--breakdown", action="store_true",
+                           help="per-phase latency aggregation "
+                                "(queue/lease/fetch/exec p50/p95/max "
+                                "per function) instead of the raw list")
         p.set_defaults(fn=_state_command)
 
     args = parser.parse_args()

@@ -260,7 +260,7 @@ class ServeController:
                     num_tpus=opts.get("num_tpus") or None,
                     max_concurrency=max(1, cfg.max_ongoing_requests),
                 ).remote(d["ctor"], tuple(d["args"]), dict(d["kwargs"]),
-                         cfg.user_config)
+                         cfg.user_config, name)
                 with self._lock:
                     replicas.append(ReplicaInfo(rid, actor))
                 changed = True
@@ -273,6 +273,20 @@ class ServeController:
         if changed:
             with self._lock:
                 self._version += 1
+        # Replica-count gauge per deployment (serve Grafana dashboard);
+        # atomically replaced so deleted deployments drop out of the series
+        # without a clear-then-set window a concurrent flush could snapshot.
+        from ray_tpu.util import metrics as um
+
+        with self._lock:
+            counts = {name: len(infos)
+                      for name, infos in self._replicas.items()}
+        um.get_gauge(
+            "ray_tpu_serve_replicas",
+            "Running replicas per serve deployment",
+            tag_keys=("deployment",),
+        ).set_many([({"deployment": name}, float(n))
+                    for name, n in counts.items()])
 
     def _kill(self, info: ReplicaInfo) -> None:
         try:

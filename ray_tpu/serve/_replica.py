@@ -13,7 +13,8 @@ from typing import Any, Dict, Optional, Tuple
 
 class ReplicaActor:
     def __init__(self, serialized_ctor, init_args: Tuple, init_kwargs: Dict,
-                 user_config: Optional[Dict[str, Any]] = None):
+                 user_config: Optional[Dict[str, Any]] = None,
+                 deployment_name: str = ""):
         import cloudpickle
 
         ctor = cloudpickle.loads(serialized_ctor)
@@ -31,6 +32,25 @@ class ReplicaActor:
 
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
+        # Serve request metrics (reference: serve/_private/metrics —
+        # the names the shipped Grafana serve dashboard charts). Counted
+        # here, at the replica, so handle calls and HTTP both register.
+        self._deployment_name = deployment_name
+        from ray_tpu.util import metrics as um
+
+        self._m_requests = um.get_counter(
+            "ray_tpu_serve_requests_total",
+            "Serve requests handled, by deployment and outcome",
+            tag_keys=("deployment", "status"))
+        self._m_latency = um.get_histogram(
+            "ray_tpu_serve_latency_seconds",
+            "Serve request latency at the replica",
+            tag_keys=("deployment",))
+        self._m_ongoing = um.get_gauge(
+            "ray_tpu_serve_ongoing_requests",
+            "Requests currently executing in this replica "
+            "(the autoscaling signal)",
+            tag_keys=("deployment", "replica"))
 
     def _resolve_method(self, method_name: str):
         if callable(self._callable) and method_name == "__call__":
@@ -83,16 +103,35 @@ class ReplicaActor:
 
     def _track(self):
         import contextlib
+        import os
+        import time
 
         @contextlib.contextmanager
         def cm():
+            t0 = time.monotonic()
+            dep = self._deployment_name
+            gauge_tags = {"deployment": dep, "replica": str(os.getpid())}
+            # gauge.set stays INSIDE the lock: counter updates and their
+            # gauge publication must be atomic, or two racing finishes can
+            # publish out of order and pin a stale nonzero value.
             with self._ongoing_lock:
                 self._ongoing += 1
+                self._m_ongoing.set(self._ongoing, tags=gauge_tags)
+            ok = True
             try:
                 yield
+            except BaseException:
+                ok = False
+                raise
             finally:
                 with self._ongoing_lock:
                     self._ongoing -= 1
+                    self._m_ongoing.set(self._ongoing, tags=gauge_tags)
+                self._m_requests.inc(tags={
+                    "deployment": dep,
+                    "status": "ok" if ok else "error"})
+                self._m_latency.observe(time.monotonic() - t0,
+                                        tags={"deployment": dep})
 
         return cm()
 

@@ -1,4 +1,4 @@
-"""User metrics API: Counter / Gauge / Histogram (reference:
+"""User + runtime metrics API: Counter / Gauge / Histogram (reference:
 ray.util.metrics -> Cython metric.pxi -> OpenCensus -> per-node agent ->
 Prometheus; here the aggregation floor: per-process metric registries
 flushed into the GCS KV and merged by the state reader).
@@ -6,23 +6,75 @@ flushed into the GCS KV and merged by the state reader).
 Each process flushes its own snapshot under `metrics:<pid-uuid>`; readers
 merge across processes (counters sum, gauges take the freshest, histogram
 buckets sum). No exporter daemon needed to scrape: anything that can call
-the state API (CLI, dashboard) can read cluster metrics."""
+the state API (CLI, dashboard) can read cluster metrics.
+
+Processes WITHOUT a connected Worker (the nodelet and the GCS server)
+install a flush sink via `set_flush_sink` — the flusher hands them the
+pickled snapshot and they ship it over their own GCS client (or, for the
+GCS itself, write it straight into the KV table).
+
+Runtime components create their metrics through the `get_counter` /
+`get_gauge` / `get_histogram` factories, which dedupe by name so
+instrumentation sites can run in any order (and repeatedly) without
+double-registering.
+"""
 
 from __future__ import annotations
 
 import bisect
+import os
 import pickle
 import threading
 import time
 import uuid
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-_FLUSH_INTERVAL_S = 2.0
+_FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_METRICS_INTERVAL_S", "2.0"))
 
 _registry_lock = threading.Lock()
 _registry: List["_Metric"] = []
+_named: Dict[str, "_Metric"] = {}
 _flusher_started = False
-_process_key = f"metrics:{uuid.uuid4().hex[:12]}"
+_flush_sink: Optional[Callable[[str, bytes], None]] = None
+
+
+def _new_process_key() -> str:
+    return f"metrics:{uuid.uuid4().hex[:12]}"
+
+
+_process_key = _new_process_key()
+
+
+def _reset_after_fork() -> None:
+    """Forked children must NOT keep the parent's identity: flushing the
+    inherited registry under the parent's key would overwrite the parent's
+    KV snapshot (same bug class as the forked-worker ID reuse fixed in
+    round 5), and re-reporting the parent's counts under a fresh key would
+    double count. New key, fresh locks (a lock held at fork time would
+    deadlock the child), cleared values, flusher re-armed lazily."""
+    global _process_key, _flusher_started, _flush_sink, _registry_lock, \
+        _named_lock
+    _registry_lock = threading.Lock()
+    _named_lock = threading.Lock()
+    _process_key = _new_process_key()
+    _flush_sink = None
+    _flusher_started = False
+    for m in _registry:
+        m._lock = threading.Lock()
+        m._values = {}
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reset_after_fork)
+
+
+def set_flush_sink(sink: Optional[Callable[[str, bytes], None]]) -> None:
+    """Route flushes through `sink(process_key, payload)` instead of the
+    global worker's GCS client — for processes that have no Worker (the
+    nodelet ships via its own GCS RpcClient; the GCS server writes into
+    its own KV table directly)."""
+    global _flush_sink
+    _flush_sink = sink
 
 
 def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple:
@@ -53,6 +105,12 @@ class _Metric:
                 "ts": time.time(),
             }
 
+    def clear(self) -> None:
+        """Drop every recorded series (sampler loops that re-set labelled
+        gauges each round use this so dead workers' series don't linger)."""
+        with self._lock:
+            self._values.clear()
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -71,6 +129,18 @@ class Gauge(_Metric):
             tags: Optional[Dict[str, str]] = None) -> None:
         with self._lock:
             self._values[_tags_key(tags)] = float(value)
+
+    def set_many(self, items: "Sequence[Tuple[Optional[Dict[str, str]], float]]",
+                 clear: bool = True) -> None:
+        """Replace (or update) every labelled series atomically — sampler
+        loops use this instead of clear()-then-set, which would let a
+        concurrent flusher snapshot the empty window between the two."""
+        new = {_tags_key(tags): float(v) for tags, v in items}
+        with self._lock:
+            if clear:
+                self._values = new
+            else:
+                self._values.update(new)
 
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
@@ -99,23 +169,79 @@ class Histogram(_Metric):
 
 
 # ---------------------------------------------------------------------------
-def _flush_once() -> None:
-    from ray_tpu._private import worker as wm
+# Named factories — runtime instrumentation entry points.
+# ---------------------------------------------------------------------------
+_named_lock = threading.Lock()
 
-    w = wm._global_worker  # avoid creating a worker just to flush
-    if w is None or not w.connected:
-        return
+
+def _get_named(cls, name: str, *args, **kwargs):
+    m = _named.get(name)
+    if m is None:
+        with _named_lock:
+            m = _named.get(name)
+            if m is None:
+                m = cls(name, *args, **kwargs)
+                _named[name] = m
+    # A forked child's registry is all cache hits (the names were created
+    # pre-fork), so re-arming the flusher cannot be left to _Metric.__init__
+    # alone — without this the child would never ship its telemetry.
+    _ensure_flusher()
+    return m
+
+
+def get_counter(name: str, description: str = "",
+                tag_keys: Sequence[str] = ()) -> Counter:
+    return _get_named(Counter, name, description, tag_keys)
+
+
+def get_gauge(name: str, description: str = "",
+              tag_keys: Sequence[str] = ()) -> Gauge:
+    return _get_named(Gauge, name, description, tag_keys)
+
+
+def get_histogram(name: str, description: str = "",
+                  boundaries: Sequence[float] = DEFAULT_BUCKETS,
+                  tag_keys: Sequence[str] = ()) -> Histogram:
+    return _get_named(Histogram, name, description, boundaries, tag_keys)
+
+
+def telemetry_flush_histogram() -> Histogram:
+    """The telemetry pipeline's own flush-latency self-metric — defined
+    once here, shared by the metrics flusher and the task-event loop."""
+    return get_histogram(
+        "ray_tpu_telemetry_flush_seconds",
+        "Latency of telemetry pipeline flushes to the GCS",
+        tag_keys=("pipeline",))
+
+
+# ---------------------------------------------------------------------------
+def _flush_once() -> None:
     with _registry_lock:
         snaps = [m.snapshot() for m in _registry]
     if not snaps:
         return
     payload = pickle.dumps(snaps, protocol=5)
-    w.loop_thread.run(w.gcs_client.call(
-        "kv_put", key=_process_key, value=payload))
+    t0 = time.monotonic()
+    sink = _flush_sink
+    if sink is not None:
+        sink(_process_key, payload)
+    else:
+        from ray_tpu._private import worker as wm
+
+        w = wm._global_worker  # avoid creating a worker just to flush
+        if w is None or not w.connected:
+            return
+        w.loop_thread.run(w.gcs_client.call(
+            "kv_put", key=_process_key, value=payload))
+    # Telemetry-pipeline self-metric; lands in the NEXT snapshot.
+    telemetry_flush_histogram().observe(time.monotonic() - t0,
+                                        tags={"pipeline": "metrics"})
 
 
 def _ensure_flusher() -> None:
     global _flusher_started
+    if _flusher_started:  # lock-free fast path: called on every metric hit
+        return
     with _registry_lock:
         if _flusher_started:
             return
@@ -137,6 +263,40 @@ def flush() -> None:
     _flush_once()
 
 
+def merge_snapshot(merged: Dict[str, Dict[str, Any]],
+                   freshest: Dict[Tuple[str, Tuple], float],
+                   snaps: List[Dict[str, Any]]) -> None:
+    """Fold one process's snapshot list into the cluster-wide view
+    (counters sum, gauges keep the freshest by snapshot ts, histogram
+    buckets/sum/count add). Pure — shared by query_metrics() and tests."""
+    for snap in snaps:
+        m = merged.setdefault(snap["name"], {
+            "kind": snap["kind"],
+            "description": snap["description"],
+            "values": {},
+        })
+        for tags, val in snap["values"].items():
+            if snap["kind"] == "counter":
+                m["values"][tags] = m["values"].get(tags, 0.0) + val
+            elif snap["kind"] == "gauge":
+                fk = (snap["name"], tags)
+                if snap["ts"] >= freshest.get(fk, 0.0):
+                    freshest[fk] = snap["ts"]
+                    m["values"][tags] = val
+            else:
+                cur = m["values"].get(tags)
+                if cur is None:
+                    m["values"][tags] = {
+                        "boundaries": val["boundaries"],
+                        "counts": list(val["counts"]),
+                        "sum": val["sum"], "count": val["count"]}
+                else:
+                    cur["counts"] = [a + b for a, b in
+                                     zip(cur["counts"], val["counts"])]
+                    cur["sum"] += val["sum"]
+                    cur["count"] += val["count"]
+
+
 def query_metrics() -> Dict[str, Dict[str, Any]]:
     """Cluster-wide merged view {metric_name: {kind, values}} (counters
     sum across processes; gauges keep the freshest; histograms merge)."""
@@ -150,42 +310,21 @@ def query_metrics() -> Dict[str, Dict[str, Any]]:
         raw = w.loop_thread.run(w.gcs_client.call("kv_get", key=key))
         if raw is None:
             continue
-        for snap in pickle.loads(bytes(raw)):
-            m = merged.setdefault(snap["name"], {
-                "kind": snap["kind"],
-                "description": snap["description"],
-                "values": {},
-            })
-            for tags, val in snap["values"].items():
-                if snap["kind"] == "counter":
-                    m["values"][tags] = m["values"].get(tags, 0.0) + val
-                elif snap["kind"] == "gauge":
-                    fk = (snap["name"], tags)
-                    if snap["ts"] >= freshest.get(fk, 0.0):
-                        freshest[fk] = snap["ts"]
-                        m["values"][tags] = val
-                else:
-                    cur = m["values"].get(tags)
-                    if cur is None:
-                        m["values"][tags] = {
-                            "boundaries": val["boundaries"],
-                            "counts": list(val["counts"]),
-                            "sum": val["sum"], "count": val["count"]}
-                    else:
-                        cur["counts"] = [a + b for a, b in
-                                         zip(cur["counts"], val["counts"])]
-                        cur["sum"] += val["sum"]
-                        cur["count"] += val["count"]
+        try:
+            snaps = pickle.loads(bytes(raw))
+        except Exception:
+            continue  # one corrupt snapshot must not kill the whole scrape
+        merge_snapshot(merged, freshest, snaps)
     return merged
 
 
-def prometheus_text() -> str:
-    """Cluster metrics in Prometheus text exposition format (reference:
-    _private/prometheus_exporter.py serving the metrics agent's registry;
-    here generated straight from the GCS-merged view and served by the
-    dashboard's /metrics route)."""
+def render_prometheus(merged: Dict[str, Dict[str, Any]]) -> str:
+    """Render a merged metrics view in Prometheus text exposition format
+    (reference: _private/prometheus_exporter.py serving the metrics agent's
+    registry). Pure — prometheus_text() feeds it the GCS-merged view and
+    the dashboard's /metrics route serves the result."""
     lines = []
-    for name, m in sorted(query_metrics().items()):
+    for name, m in sorted(merged.items()):
         pname = name.replace(".", "_").replace("-", "_")
         if m.get("description"):
             lines.append(f"# HELP {pname} {m['description']}")
@@ -213,6 +352,12 @@ def prometheus_text() -> str:
             lines.append(f"{pname}_sum{suffix} {val['sum']}")
             lines.append(f"{pname}_count{suffix} {val['count']}")
     return "\n".join(lines) + "\n"
+
+
+def prometheus_text() -> str:
+    """Cluster metrics in Prometheus text exposition format, straight from
+    the GCS-merged view (served by the dashboard's /metrics route)."""
+    return render_prometheus(query_metrics())
 
 
 def _escape_label(value) -> str:

@@ -161,26 +161,104 @@ def list_tasks(limit: int = 1000) -> List[Dict[str, Any]]:
 
 def timeline(path: Optional[str] = None) -> Any:
     """chrome://tracing dump of recorded task events (reference:
-    `ray timeline`, scripts.py:2689)."""
+    `ray timeline`, scripts.py:2689). Events missing the required fields
+    (a crashed reporter, a partial flush) are skipped, not fatal; the
+    parent span id rides along in args so driver spans, task rows, and
+    runtime phase spans read as one connected trace."""
     import json
 
     events = []
     for ev in list_tasks(limit=20_000):
+        name = ev.get("name")
+        start = ev.get("start_ts")
+        end = ev.get("end_ts")
+        if name is None or start is None or end is None:
+            continue  # malformed event must not kill the whole dump
+        args = {"task_id": ev.get("task_id", ""), "ok": ev.get("ok", True)}
+        if ev.get("parent"):
+            args["parent"] = ev["parent"]
         events.append({
-            "name": ev["name"],
+            "name": name,
             "cat": ev.get("type", "TASK"),
             "ph": "X",
-            "ts": ev["start_ts"] * 1e6,
-            "dur": max(0.0, (ev["end_ts"] - ev["start_ts"]) * 1e6),
+            "ts": start * 1e6,
+            "dur": max(0.0, (end - start) * 1e6),
             "pid": ev.get("node_id", "")[:8],
             "tid": ev.get("pid", 0),
-            "args": {"task_id": ev["task_id"], "ok": ev.get("ok", True)},
+            "args": args,
         })
     if path is None:
         return events
     with open(path, "w") as f:
         json.dump(events, f)
     return path
+
+
+def _latency_summary(vals: List[float]) -> Dict[str, float]:
+    vals = sorted(vals)
+    n = len(vals)
+    return {
+        "count": n,
+        "mean": sum(vals) / n,
+        "p50": vals[int(0.5 * (n - 1))],
+        "p95": vals[int(0.95 * (n - 1))],
+        "max": vals[-1],
+    }
+
+
+def task_latency_breakdown(limit: int = 20_000) -> Dict[str, Any]:
+    """Where task time goes, per function name (reference: the
+    GcsTaskManager state timeline feeding `ray summary tasks`): each task
+    event carries lifecycle stamps SUBMITTED → LEASE_GRANTED → received →
+    ARGS_READY → FINISHED, aggregated here into per-phase p50/p95/max:
+
+      queue: submit → lease grant   (waiting for a worker lease)
+      lease: lease grant → receipt  (push/transit to the leased worker)
+      fetch: receipt → args ready   (argument resolution / object fetch)
+      exec:  args ready → return    (user code)
+
+    queue+lease+fetch+exec telescopes to e2e (end - submit) — exactly on
+    one host; under cross-host clock skew the lease phase is dropped
+    rather than reported negative."""
+    per_fn: Dict[str, Dict[str, List[float]]] = {}
+    for ev in list_tasks(limit=limit):
+        if ev.get("type") not in ("NORMAL_TASK", "ACTOR_TASK",
+                                  "ACTOR_CREATION_TASK"):
+            continue
+        name = ev.get("name")
+        start = ev.get("start_ts")
+        end = ev.get("end_ts")
+        if name is None or start is None or end is None:
+            continue
+        sub = ev.get("submitted_ts")
+        lease = ev.get("lease_ts")
+        ready = ev.get("args_ready_ts")
+        phases: Dict[str, float] = {}
+        # queue is measured entirely on the owner's clock — valid even when
+        # cross-host skew makes lease_ts (owner) disagree with start_ts
+        # (executor); only the lease/transit phase needs both clocks.
+        if sub and lease and sub <= lease:
+            phases["queue"] = lease - sub
+            if lease <= start:
+                phases["lease"] = start - lease
+        if ready and start <= ready <= end:
+            phases["fetch"] = ready - start
+            phases["exec"] = end - ready
+        else:
+            # No args_ready stamp = argument resolution never finished
+            # (failed fetch). Charge the interval to fetch, not exec —
+            # user code never ran (mirrors the exec-histogram guard in
+            # worker.record_task_event).
+            phases["fetch"] = end - start
+        if sub and sub <= end:
+            phases["e2e"] = end - sub
+        d = per_fn.setdefault(name, {})
+        for ph, v in phases.items():
+            d.setdefault(ph, []).append(v)
+    return {
+        name: {ph: _latency_summary(vals) for ph, vals in sorted(d.items())}
+        for name, d in sorted(per_fn.items())
+    }
 
 
 def summarize_actors() -> Dict[str, int]:

@@ -52,3 +52,40 @@ def span(name: str, **attributes) -> Iterator[str]:
 
 def current_span_id() -> Optional[str]:
     return _current_span.get()
+
+
+def emit_runtime_spans(worker, spec, recv_ts: float,
+                       args_ready_ts: Optional[float],
+                       end_ts: float) -> None:
+    """Stitched traces across processes: when a task was submitted under a
+    driver-side span (spec.trace_parent), emit the runtime phases as spans
+    chained under the task's own row — driver span → task → queue/lease/
+    fetch/exec — so `state.timeline()` renders one connected trace
+    (reference: tracing_helper.py wrapping submit AND execute in linked
+    spans). Phase names deliberately match state.task_latency_breakdown():
+
+    queue: submit → lease grant   (owner-side stamps riding the spec)
+    lease: lease grant → executor receipt (push/transit)
+    fetch: executor receipt → args resolved
+    exec:  args resolved → return
+    """
+    task_hex = spec.task_id.hex()
+    phases = []
+    if (spec.submitted_ts and spec.lease_ts
+            and spec.lease_ts >= spec.submitted_ts):
+        phases.append(("queue", spec.submitted_ts, spec.lease_ts))
+        if recv_ts >= spec.lease_ts:
+            phases.append(("lease", spec.lease_ts, recv_ts))
+    if args_ready_ts is not None and args_ready_ts >= recv_ts:
+        phases.append(("fetch", recv_ts, args_ready_ts))
+        phases.append(("exec", args_ready_ts, end_ts))
+    for phase, start, end in phases:
+        worker.record_event({
+            "task_id": f"{task_hex}:{phase}",
+            "name": f"phase:{phase}",
+            "type": "RUNTIME_SPAN",
+            "parent": task_hex,
+            "start_ts": start,
+            "end_ts": end,
+            "ok": True,
+        })
