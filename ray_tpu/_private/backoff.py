@@ -1,0 +1,73 @@
+"""Unified retry backoff: exponential with full jitter + overall deadline.
+
+Every retry loop in the runtime (RPC reconnect, lease resubmit, actor
+scheduling/resubmit) draws its sleep from here instead of raw
+``retry_backoff_initial_s`` sleeps. Full jitter (uniform over [0, cap],
+AWS-style) de-synchronizes retry herds — under delay chaos, fixed sleeps
+made every failed submitter hammer the nodelet in lockstep; the overall
+deadline turns "retry forever politely" into a bounded promise.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Optional
+
+from ray_tpu.utils.config import get_config
+
+
+def delay_for_attempt(attempt: int, initial: Optional[float] = None,
+                      maximum: Optional[float] = None) -> float:
+    """Full-jitter delay for retry number ``attempt`` (0-based):
+    uniform(0, min(maximum, initial * 2**attempt))."""
+    cfg = get_config()
+    initial = cfg.retry_backoff_initial_s if initial is None else initial
+    maximum = cfg.retry_backoff_max_s if maximum is None else maximum
+    cap = min(maximum, initial * (2 ** min(attempt, 32)))
+    return random.uniform(0, cap)
+
+
+class Backoff:
+    """Stateful policy for one retry burst: call ``sleep()`` between
+    attempts; it returns False (without sleeping past it) once the
+    overall deadline is exhausted."""
+
+    def __init__(self, initial: Optional[float] = None,
+                 maximum: Optional[float] = None,
+                 deadline: Optional[float] = None):
+        cfg = get_config()
+        self.initial = (cfg.retry_backoff_initial_s
+                        if initial is None else initial)
+        self.maximum = (cfg.retry_backoff_max_s
+                        if maximum is None else maximum)
+        span = cfg.retry_deadline_s if deadline is None else deadline
+        self.deadline = time.monotonic() + span if span > 0 else None
+        self.attempt = 0
+
+    def expired(self) -> bool:
+        return (self.deadline is not None
+                and time.monotonic() >= self.deadline)
+
+    def next_delay(self) -> float:
+        d = delay_for_attempt(self.attempt, self.initial, self.maximum)
+        self.attempt += 1
+        if self.deadline is not None:
+            d = min(d, max(0.0, self.deadline - time.monotonic()))
+        return d
+
+    async def sleep(self) -> bool:
+        if self.expired():
+            return False
+        await asyncio.sleep(self.next_delay())
+        return True
+
+    def sleep_sync(self) -> bool:
+        if self.expired():
+            return False
+        time.sleep(self.next_delay())
+        return True
+
+    def reset(self) -> None:
+        self.attempt = 0

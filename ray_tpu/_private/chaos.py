@@ -1,0 +1,246 @@
+"""Deterministic chaos engine: latency, partition, and failure injection.
+
+Counterpart of the reference's src/ray/common/asio/asio_chaos.cc (event-loop
+delay injection via ``RAY_testing_asio_delay_us``) and src/ray/rpc/rpc_chaos.h
+(per-method failure probabilities via ``RAY_testing_rpc_failure``), promoted
+into one first-class subsystem:
+
+* **Failures** — ``RAY_TPU_TESTING_RPC_FAILURE="key:prob,..."`` raises an
+  injected error on matching RPC methods *and* named failpoints.
+* **Latency** — ``RAY_TPU_CHAOS_DELAY_MS="pattern=min:max[:prob],..."``
+  sleeps a uniform [min, max] ms before the matching event. Patterns are
+  fnmatch-style and match three injection points per RPC method: the client
+  send path (``<method>``), server-side handler dispatch
+  (``server.<method>``), and client reply delivery (``recv.<method>``) —
+  so ``*lease_worker`` delays all three. Delayed dispatch/delivery runs in
+  its own task, so delays genuinely *reorder* concurrent events, the class
+  of bug asio_chaos exists to catch.
+* **Partitions** — ``RAY_TPU_CHAOS_PARTITION="method[@peer]:dir[:prob]"``
+  blackholes one direction of a method: ``send`` drops the request before
+  the wire, ``recv`` drops the reply after it arrives (the server DID
+  execute — e.g. heartbeats reach the GCS but the acks vanish).
+* **Failpoints** — non-RPC subsystems call ``failpoint("name")`` at
+  crash-prone seams (``gcs.snapshot_save``, ``object_store.spill``,
+  ``nodelet.lease_grant``, ``nodelet.zygote_fork``); the failure and delay
+  specs above match failpoint names exactly like method names.
+
+Determinism: with ``RAY_TPU_CHAOS_SEED=<n>`` every decision is a pure
+function of (seed, key, per-key call index) — two runs issuing the same
+calls per key get the *identical* fault schedule regardless of thread or
+event-loop interleaving between keys. Seed 0 (default) draws from an
+unseeded RNG. Every fired decision is recorded in a bounded schedule log;
+``schedule_digest()`` lets tests assert cross-run reproducibility cheaply.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import threading
+import time
+from collections import deque
+from fnmatch import fnmatchcase
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.utils.config import get_config
+
+SEND = "send"
+RECV = "recv"
+BOTH = "both"
+
+
+class ChaosInjectedError(Exception):
+    """Raised by an injected failure (failpoints; RPC paths substitute
+    their own transport error class so retry handling stays uniform)."""
+
+
+def _parse_failures(spec: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        key, prob = part.rsplit(":", 1)
+        out[key.strip()] = float(prob)
+    return out
+
+
+def _parse_delays(spec: str) -> List[Tuple[str, float, float, float]]:
+    """"pattern=min:max[:prob]" (ms) -> [(pattern, min_s, max_s, prob)]."""
+    out: List[Tuple[str, float, float, float]] = []
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        pattern, _, rest = part.partition("=")
+        fields = rest.split(":")
+        lo = float(fields[0]) / 1000.0
+        hi = float(fields[1]) / 1000.0 if len(fields) > 1 else lo
+        prob = float(fields[2]) if len(fields) > 2 else 1.0
+        out.append((pattern.strip(), lo, max(lo, hi), prob))
+    return out
+
+
+def _parse_partitions(spec: str) -> List[Tuple[str, str, str, float]]:
+    """"method[@peer][:dir][:prob]" -> [(method_pat, peer_pat, dir, prob)].
+
+    dir is send|recv|both (default both); patterns are fnmatch-style.
+    """
+    out: List[Tuple[str, str, str, float]] = []
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        fields = part.strip().split(":")
+        target = fields[0]
+        direction = BOTH
+        prob = 1.0
+        if len(fields) > 1 and fields[1]:
+            direction = fields[1].strip().lower()
+        if len(fields) > 2:
+            prob = float(fields[2])
+        method_pat, _, peer_pat = target.partition("@")
+        out.append((method_pat.strip(), peer_pat.strip() or "*",
+                    direction, prob))
+    return out
+
+
+class ChaosEngine:
+    """One per-process fault oracle. Thread-safe; zero-cost when no spec
+    is configured (``enabled`` is False and every call short-circuits)."""
+
+    SCHEDULE_CAP = 20_000
+
+    def __init__(self, cfg: Any = None):
+        cfg = cfg or get_config()
+        self.seed = int(getattr(cfg, "chaos_seed", 0) or 0)
+        self.failures = _parse_failures(
+            getattr(cfg, "testing_rpc_failure", "") or "")
+        self.delays = _parse_delays(
+            getattr(cfg, "chaos_delay_ms", "") or "")
+        self.partitions = _parse_partitions(
+            getattr(cfg, "chaos_partition", "") or "")
+        self.enabled = bool(self.failures or self.delays or self.partitions)
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.schedule: "deque" = deque(maxlen=self.SCHEDULE_CAP)
+        if self.seed == 0:
+            import random
+
+            self._rng = random.Random()
+        else:
+            self._rng = None
+
+    # -- the deterministic draw ---------------------------------------
+    def _draw(self, key: str) -> float:
+        """Uniform [0, 1) as a pure function of (seed, key, call index):
+        interleaving between keys cannot perturb any key's stream."""
+        with self._lock:
+            n = self._counters.get(key, 0)
+            self._counters[key] = n + 1
+        if self._rng is not None:
+            return self._rng.random()
+        h = hashlib.sha256(f"{self.seed}:{key}:{n}".encode()).digest()
+        return int.from_bytes(h[:8], "big") / 2.0**64
+
+    def _record(self, key: str, action: str, value: float) -> None:
+        self.schedule.append((key, action, round(value, 9)))
+
+    # -- failures ------------------------------------------------------
+    def maybe_fail(self, key: str, exc_type: type = ChaosInjectedError,
+                   ) -> None:
+        if not self.failures:
+            return
+        p = self.failures.get(key)
+        if p and self._draw(key + "#fail") < p:
+            self._record(key, "fail", 1.0)
+            raise exc_type(f"chaos-injected failure for {key}")
+
+    # -- latency -------------------------------------------------------
+    def delay_s(self, key: str) -> float:
+        """Seconds of injected delay for this event (0.0 = none)."""
+        if not self.delays:
+            return 0.0
+        for pattern, lo, hi, prob in self.delays:
+            if not fnmatchcase(key, pattern):
+                continue
+            if prob < 1.0 and self._draw(key + "#dprob") >= prob:
+                return 0.0
+            d = lo + self._draw(key + "#delay") * (hi - lo)
+            if d > 0:
+                self._record(key, "delay", d)
+            return d
+        return 0.0
+
+    async def inject_delay(self, key: str) -> None:
+        d = self.delay_s(key)
+        if d > 0:
+            await asyncio.sleep(d)
+
+    # -- partitions ----------------------------------------------------
+    def should_drop(self, method: str, direction: str,
+                    peer: str = "") -> bool:
+        if not self.partitions:
+            return False
+        for method_pat, peer_pat, pdir, prob in self.partitions:
+            if pdir != BOTH and pdir != direction:
+                continue
+            if not fnmatchcase(method, method_pat):
+                continue
+            if not fnmatchcase(peer or "", peer_pat):
+                continue
+            # Peer is part of the draw key: each connection gets its own
+            # counter stream, so which peer's message drops can't depend
+            # on arrival interleaving between peers.
+            if prob < 1.0 and self._draw(
+                    f"{method}@{peer}#{direction}#drop") >= prob:
+                return False
+            self._record(f"{method}@{peer}", "drop-" + direction, 1.0)
+            return True
+        return False
+
+    # -- named failpoints (non-RPC subsystems) -------------------------
+    def failpoint(self, name: str) -> None:
+        """Synchronous failpoint: sleeps any configured delay, then raises
+        ChaosInjectedError at the configured probability."""
+        if not self.enabled:
+            return
+        d = self.delay_s(name)
+        if d > 0:
+            time.sleep(d)
+        self.maybe_fail(name)
+
+    async def failpoint_async(self, name: str) -> None:
+        if not self.enabled:
+            return
+        await self.inject_delay(name)
+        self.maybe_fail(name)
+
+    # -- observability -------------------------------------------------
+    def schedule_digest(self) -> str:
+        """Stable hash of every decision fired so far (reproducibility
+        assertions across runs)."""
+        h = hashlib.sha256()
+        for key, action, value in self.schedule:
+            h.update(f"{key}|{action}|{value}\n".encode())
+        return h.hexdigest()
+
+
+_chaos: Optional[ChaosEngine] = None
+_chaos_lock = threading.Lock()
+
+
+def get_chaos() -> ChaosEngine:
+    global _chaos
+    if _chaos is None:
+        with _chaos_lock:
+            if _chaos is None:
+                _chaos = ChaosEngine()
+    return _chaos
+
+
+def set_chaos(engine: Optional[ChaosEngine]) -> None:
+    """Install (or with None, reset) the process chaos engine — tests.
+
+    RpcClient/RpcServer capture the engine at construction (keeps the
+    disabled fast path a plain attribute check): install BEFORE creating
+    any client/server, or the old engine keeps being consulted."""
+    global _chaos
+    _chaos = engine

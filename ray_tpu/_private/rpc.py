@@ -5,8 +5,10 @@ Redesigned rather than ported: instead of gRPC+protobuf+asio callback dispatch,
 one asyncio event-loop thread per process hosts servers and clients speaking a
 length-prefixed pickle-5 frame protocol over TCP. Large binary buffers ride as
 out-of-band pickle buffers so numpy/jax host arrays are never copied through the
-pickler. Fault-injection chaos mirrors rpc_chaos.h (env-driven per-method
-failure probabilities) for the fault-tolerance tests.
+pickler. Fault injection rides the chaos engine (_private/chaos.py — the
+promoted successor of rpc_chaos.h failure probabilities, adding seeded
+deterministic schedules, latency injection at the send/dispatch/reply
+points, and one-way partitions).
 """
 
 from __future__ import annotations
@@ -14,11 +16,11 @@ from __future__ import annotations
 import asyncio
 import itertools
 import pickle
-import random
 import struct
 import threading
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
+from ray_tpu._private.chaos import RECV, SEND, get_chaos
 from ray_tpu.utils.config import get_config
 from ray_tpu.utils.logging import get_logger
 
@@ -46,24 +48,6 @@ class RemoteError(RpcError):
     def __init__(self, exc: BaseException):
         super().__init__(repr(exc))
         self.cause = exc
-
-
-class _Chaos:
-    """RPC fault injection (reference: src/ray/rpc/rpc_chaos.h, env
-    RAY_testing_rpc_failure)."""
-
-    def __init__(self):
-        self.probs: Dict[str, float] = {}
-        spec = get_config().testing_rpc_failure
-        if spec:
-            for part in spec.split(","):
-                method, prob = part.split(":")
-                self.probs[method.strip()] = float(prob)
-
-    def maybe_fail(self, method: str) -> None:
-        p = self.probs.get(method)
-        if p and random.random() < p:
-            raise ConnectionLost(f"chaos-injected failure for {method}")
 
 
 def _dumps(obj: Any) -> Tuple[bytes, list]:
@@ -191,6 +175,7 @@ class RpcServer:
         self.port = port
         self._handlers: Dict[str, Handler] = {}
         self._server: Optional[asyncio.base_events.Server] = None
+        self._chaos = get_chaos()
 
     def register(self, method: str, handler: Handler) -> None:
         self._handlers[method] = handler
@@ -260,6 +245,12 @@ class RpcServer:
         try:
             if handler is None:
                 raise RpcError(f"no handler for method {method!r}")
+            if self._chaos.enabled:
+                # Delay chaos at the dispatch point (reference:
+                # asio_chaos.cc delaying posted handlers): each dispatch is
+                # its own task, so injected delays genuinely reorder
+                # handler execution across concurrent requests.
+                await self._chaos.inject_delay("server." + method)
             result = await handler(**kwargs)
             ok = True
         except asyncio.CancelledError:
@@ -312,7 +303,7 @@ class RpcClient:
         self._msg_ids = itertools.count(1)
         self._connect_lock = asyncio.Lock()
         self._read_task: Optional[asyncio.Task] = None
-        self._chaos = _Chaos()
+        self._chaos = get_chaos()
         self._closed = False
 
     async def connect(self) -> None:
@@ -339,6 +330,31 @@ class RpcClient:
                 fut.set_exception(exc)
         self._pending.clear()
 
+    def _blackhole(self, msg_id: int, fut: "asyncio.Future",
+                   method: str) -> None:
+        """Schedule the eventual ConnectionLost a real partition produces
+        (the kernel gives up after ~the RPC timeout): callers with their
+        own timer see that fire first, exactly as if the network ate the
+        packet, but pipelined start_call users with no timer of their own
+        must not hang forever on a partition."""
+        def _surface() -> None:
+            if not fut.done():
+                self._pending.pop(msg_id, None)
+                fut.set_exception(ConnectionLost(
+                    f"chaos partition: {method} to {self.name} blackholed"))
+
+        asyncio.get_running_loop().call_later(
+            get_config().gcs_rpc_timeout_s, _surface)
+
+    @staticmethod
+    def _deliver(fut: "asyncio.Future", payload: Tuple[bool, Any]) -> None:
+        if not fut.done():
+            ok, result = payload
+            if ok:
+                fut.set_result(result)
+            else:
+                fut.set_exception(RemoteError(result))
+
     async def _read_loop(self) -> None:
         reader, my_writer = self._reader, self._writer
         assert reader is not None
@@ -346,12 +362,27 @@ class RpcClient:
             while True:
                 _kind, msg_id, payload = await _read_frame(reader)
                 fut = self._pending.pop(msg_id, None)
-                if fut is not None and not fut.done():
-                    ok, result = payload
-                    if ok:
-                        fut.set_result(result)
-                    else:
-                        fut.set_exception(RemoteError(result))
+                if fut is None or fut.done():
+                    continue
+                if self._chaos.enabled:
+                    method = getattr(fut, "_rpc_method", "")
+                    if self._chaos.should_drop(method, RECV, peer=self.name):
+                        # One-way partition: the reply vanishes (the server
+                        # DID execute). Re-park the future so the caller's
+                        # timeout path still owns cleanup, with the bounded
+                        # blackhole backstop for timer-less callers.
+                        self._pending[msg_id] = fut
+                        self._blackhole(msg_id, fut, method)
+                        continue
+                    d = self._chaos.delay_s("recv." + method)
+                    if d > 0:
+                        # Delayed delivery reorders completion order
+                        # across in-flight calls without stalling the
+                        # read loop for other replies.
+                        asyncio.get_running_loop().call_later(
+                            d, self._deliver, fut, payload)
+                        continue
+                self._deliver(fut, payload)
         except (ConnectionLost, asyncio.CancelledError):
             pass
         except Exception as e:  # pragma: no cover
@@ -367,7 +398,9 @@ class RpcClient:
     async def start_call(self, method: str, **kwargs) -> "asyncio.Future":
         """Write the request and return the reply future without awaiting it —
         lets a caller pipeline ordered requests (actor submitter)."""
-        self._chaos.maybe_fail(method)
+        if self._chaos.enabled:
+            self._chaos.maybe_fail(method, exc_type=ConnectionLost)
+            await self._chaos.inject_delay(method)
         if self._writer is None:
             try:
                 await self.connect()
@@ -376,7 +409,15 @@ class RpcClient:
         msg_id = next(self._msg_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         fut._rpc_msg_id = msg_id  # type: ignore[attr-defined]
+        fut._rpc_method = method  # type: ignore[attr-defined]
         self._pending[msg_id] = fut
+        if self._chaos.enabled and self._chaos.should_drop(
+                method, SEND, peer=self.name):
+            # Blackholed request: never hits the wire; the caller's
+            # timeout fires exactly as if the network ate the packet,
+            # with the bounded backstop for timer-less callers.
+            self._blackhole(msg_id, fut, method)
+            return fut
         try:
             # All frame parts are written synchronously (no await between
             # them), so frames can't interleave on the single-threaded loop
@@ -418,6 +459,12 @@ class RpcClient:
         self._read_task = None
         self._writer = None
         self._reader = None
+        # Snapshot the calls in flight on THIS connection before the first
+        # await: a concurrent caller can reconnect and register futures on
+        # the fresh socket while the old read task winds down, and those
+        # must not be failed here.
+        stale = list(self._pending.values())
+        self._pending.clear()
         if task is not None:
             task.cancel()
             try:
@@ -426,12 +473,28 @@ class RpcClient:
                 pass
         if writer is not None:
             writer.close()
+        # Fail every other in-flight call NOW. The read loop's finally
+        # skips _fail_all here (self._writer was already nulled above), so
+        # without this, calls sharing the client — lease_worker on a
+        # shared nodelet client, pipelined actor pushes — would hang for
+        # their full timeouts (or forever for start_call users) after one
+        # caller's timeout reset the connection. Exposed by delay chaos.
+        exc = ConnectionLost(f"connection to {self.name} reset for retry")
+        for fut in stale:
+            if not fut.done():
+                fut.set_exception(exc)
 
     async def call_retrying(
-        self, method: str, max_attempts: int = 5, timeout: Optional[float] = None, **kwargs
+        self, method: str, max_attempts: int = 5,
+        timeout: Optional[float] = None,
+        deadline: Optional[float] = None, **kwargs
     ) -> Any:
-        cfg = get_config()
-        backoff = cfg.retry_backoff_initial_s
+        """Retry with the unified policy (_private/backoff.py): exponential
+        backoff, full jitter, bounded by an overall deadline — attempts
+        stop when either max_attempts or the deadline runs out."""
+        from ray_tpu._private.backoff import Backoff
+
+        bo = Backoff(deadline=deadline)
         last: Optional[Exception] = None
         for _ in range(max_attempts):
             try:
@@ -439,12 +502,16 @@ class RpcClient:
             except (ConnectionLost, asyncio.TimeoutError, OSError) as e:
                 last = e
                 await self._reset_connection()
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, cfg.retry_backoff_max_s)
+                if not await bo.sleep():
+                    break
         raise last  # type: ignore[misc]
 
     async def notify(self, method: str, **kwargs) -> None:
-        self._chaos.maybe_fail(method)
+        if self._chaos.enabled:
+            self._chaos.maybe_fail(method, exc_type=ConnectionLost)
+            await self._chaos.inject_delay(method)
+            if self._chaos.should_drop(method, SEND, peer=self.name):
+                return
         if self._writer is None:
             try:
                 await self.connect()

@@ -24,6 +24,7 @@ import time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import backoff as backoff_mod
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.ids import (
@@ -209,6 +210,9 @@ class LeasePool:
         self.num_leased = 0
         self.requesting = 0
         self.label_selector = getattr(spec_template, "label_selector", None)
+        # Consecutive lease failures: drives the unified full-jitter
+        # backoff (reset on any successful grant).
+        self.lease_fail_streak = 0
 
     def maybe_scale_up(self) -> None:
         cfg = get_config()
@@ -339,9 +343,12 @@ class LeasePool:
             logger.warning("lease request failed: %r", e)
             self.requesting -= 1
             # A transient RPC failure must not strand queued tasks: back off
+            # (full jitter, so N failed pools don't re-lease in lockstep)
             # and retry the scale-up, same as the resources-busy branch.
             if not self.queue.empty():
-                await asyncio.sleep(get_config().retry_backoff_initial_s)
+                await asyncio.sleep(
+                    backoff_mod.delay_for_attempt(self.lease_fail_streak))
+                self.lease_fail_streak += 1
                 self.maybe_scale_up()
             return
         self.requesting -= 1
@@ -349,9 +356,12 @@ class LeasePool:
             # Resources busy — tasks stay queued; an existing lease will drain
             # them, or a later submit retries the scale-up.
             if self.num_leased == 0 and not self.queue.empty():
-                await asyncio.sleep(0.5)
+                await asyncio.sleep(backoff_mod.delay_for_attempt(
+                    self.lease_fail_streak, initial=0.5, maximum=5.0))
+                self.lease_fail_streak += 1
                 self.maybe_scale_up()
             return
+        self.lease_fail_streak = 0
         self.num_leased += 1
         worker_id = lease["worker_id"]
         addr = tuple(lease["worker_address"])
@@ -597,7 +607,10 @@ class ActorSubmitter:
                                attempt: int, exc: BaseException) -> None:
         self.reset()
         if attempt < retries:
-            await asyncio.sleep(get_config().retry_backoff_initial_s)
+            # Unified policy: grow with the attempt number and jitter —
+            # a fixed initial sleep made every resubmitting caller hammer
+            # a restarting actor in lockstep under delay chaos.
+            await asyncio.sleep(backoff_mod.delay_for_attempt(attempt))
             self.queue.put_nowait((spec, retries, attempt + 1))
             return
         # Distinguish dead vs transient for the error type.
@@ -636,10 +649,40 @@ class ActorSubmitter:
         # (reference: actor submitters subscribe to GCS actor pubsub).
         w = self.worker
         info = await w.actor_state(self.actor_id, refresh=True)
+        rechecked = False
         while True:
             if info is None:
+                # Registration race: anonymous creation is fire-and-forget,
+                # so this process's register_actor RPC may still be in
+                # flight (delayed/retrying) when the first task's get_actor
+                # lands. None is PENDING while that send is outstanding —
+                # raising "was never created" here failed the first call
+                # spuriously under delay chaos.
+                cached = w._actor_states.get(self.actor_id.hex())
+                if cached is not None:
+                    # e.g. the poisoned DEAD entry a failed async
+                    # registration writes locally.
+                    info = cached
+                    continue
+                if self.actor_id.hex() in w._registering_actors:
+                    if time.monotonic() > deadline:
+                        raise ActorUnavailableError(
+                            f"actor {self.actor_id} registration still in "
+                            f"flight after worker_start_timeout_s")
+                    info = await w.actor_state(
+                        self.actor_id,
+                        wait_change=min(1.0, max(
+                            0.05, deadline - time.monotonic())))
+                    continue
+                if not rechecked:
+                    # The registration may have completed between our
+                    # get_actor and the in-flight check: read once more
+                    # AFTER observing the set empty before condemning.
+                    rechecked = True
+                    info = await w.actor_state(self.actor_id, refresh=True)
+                    continue
                 raise ActorDiedError(f"actor {self.actor_id} was never created")
-            if info["state"] == "ALIVE" and info["address"]:
+            if info["state"] == "ALIVE" and info.get("address"):
                 self.address = tuple(info["address"])
                 self.client = RpcClient(*self.address, name="actor")
                 # Prefer the worker's fast lane (zero intra-worker hops;
@@ -656,8 +699,11 @@ class ActorSubmitter:
                     pass  # older/busy worker: normal lane works fine
                 return self.client
             if info["state"] == "DEAD":
+                # A poisoned local cache entry (failed async registration)
+                # carries "error", a GCS view carries "death_cause".
                 raise ActorDiedError(
-                    f"actor {self.actor_id} is dead: {info['death_cause']}")
+                    f"actor {self.actor_id} is dead: "
+                    f"{info.get('death_cause') or info.get('error')}")
             if time.monotonic() > deadline:
                 raise ActorUnavailableError(
                     f"actor {self.actor_id} stuck in {info['state']}")
@@ -766,6 +812,10 @@ class Worker:
         self._actor_states: Dict[str, Dict[str, Any]] = {}
         self._actor_pulse = asyncio.Event()
         self._actor_sub_started = False
+        # Anonymous-actor registrations this process fired asynchronously
+        # and whose GCS reply hasn't landed: while an id is in here,
+        # get_actor -> None means PENDING, not "was never created".
+        self._registering_actors: set = set()
         self._log_sub_started = False
         # Task-event buffer (timeline/profiling floor).
         self._task_events: List[Dict[str, Any]] = []
@@ -2270,6 +2320,8 @@ class Worker:
                 reply = await register
             except Exception as e:  # noqa: BLE001
                 reply = {"ok": False, "error": repr(e)}
+            finally:
+                self._registering_actors.discard(actor_id.hex())
             if not reply.get("ok"):
                 logger.warning("async actor registration failed: %s",
                                reply.get("error"))
@@ -2281,6 +2333,10 @@ class Worker:
                 self._actor_pulse.set()
                 self._actor_pulse.clear()
 
+        # Mark in flight BEFORE scheduling: the first actor task can race
+        # the registration RPC, and its _ensure_client must read
+        # get_actor -> None as pending, not dead (registration-race fix).
+        self._registering_actors.add(actor_id.hex())
         asyncio.run_coroutine_threadsafe(_register(), self.loop)
         return actor_id
 

@@ -158,7 +158,11 @@ class AutoscalerV2:
         GCS actually report (reference: Reconciler.sync_from)."""
         from ray_tpu.util import state
 
-        provider_nodes = {id(n): n for n in self.provider.nodes()}
+        # Key by the stable node key, never Python id(): a provider that
+        # rebuilds value-equal handles per nodes() call (natural for cloud
+        # list APIs) would otherwise make every RAY_RUNNING instance look
+        # "provider lost" and get a healthy node TERMINATED.
+        provider_nodes = {_node_key(n) for n in self.provider.nodes()}
         try:
             alive = {n["node_id"]: n for n in state.list_nodes()
                      if n["alive"]}
@@ -174,7 +178,7 @@ class AutoscalerV2:
                     inst.set_state(RAY_RUNNING)
             if inst.state == RAY_RUNNING:
                 if inst.node is not None \
-                        and id(inst.node) not in provider_nodes:
+                        and _node_key(inst.node) not in provider_nodes:
                     # provider lost it (preemption/crash)
                     inst.set_state(TERMINATED,
                                    error="provider lost instance")

@@ -20,6 +20,8 @@ import pickle
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private.backoff import Backoff, delay_for_attempt
+from ray_tpu._private.chaos import get_chaos
 from ray_tpu._private.ids import ActorID, JobID, NodeID, PlacementGroupID
 from ray_tpu._private.rpc import RpcClient, RpcServer
 from ray_tpu._private.task_spec import ResourceSet
@@ -237,6 +239,10 @@ class GcsStorage:
     def save(self, tables: Dict[str, Any]) -> None:
         if self.client is None:
             return
+        # Chaos seam: an injected failure here must leave dirty=True so
+        # the flush loop retries (exactly the contract a full disk or a
+        # killed store process exercises).
+        get_chaos().failpoint("gcs.snapshot_save")
         self.client.save(tables)
         self.dirty = False
 
@@ -250,7 +256,10 @@ class GcsServer:
         self.actors: Dict[ActorID, ActorInfo] = {}
         # kill_actor arrivals for ids not registered yet (client-side
         # async actor creation): the late registration lands dead.
-        self._prekilled: set = set()
+        # id -> tombstone time; TTL + size cap bound it (repeated kills of
+        # bogus ids, or registrations that never arrive, must not grow it
+        # forever). Insertion-ordered, so eviction drops the oldest.
+        self._prekilled: Dict[ActorID, float] = {}
         self.named_actors: Dict[str, ActorID] = {}
         self.placement_groups: Dict[PlacementGroupID, PlacementGroupInfo] = {}
         self.kv: Dict[str, bytes] = {}
@@ -762,10 +771,9 @@ class GcsServer:
         info = ActorInfo(aid, creation_spec, name, max_restarts, detached)
         self.actors[aid] = info
         self.mark_dirty()
-        if aid in self._prekilled:
+        if self._prekilled.pop(aid, None) is not None:
             # A kill raced ahead of this (asynchronous) registration:
             # land the actor dead instead of scheduling a zombie.
-            self._prekilled.discard(aid)
             await self._actor_dead(info, "killed before registration")
             return {"ok": True}
         asyncio.ensure_future(self._schedule_actor(info))
@@ -784,8 +792,11 @@ class GcsServer:
 
         spec = pickle.loads(info.creation_spec)
         cfg = get_config()
-        backoff = cfg.retry_backoff_initial_s
-        deadline = time.monotonic() + cfg.worker_start_timeout_s
+        # Unified retry policy: full-jitter backoff de-synchronizes actor
+        # scheduling herds (N restarting actors after a node death).
+        # One clock for the whole scheduling budget: bo paces the retries
+        # AND bounds them (bo.expired() is the terminal check).
+        bo = Backoff(deadline=cfg.worker_start_timeout_s)
         strategy = spec.scheduling_strategy
         while info.state in (ACTOR_PENDING, ACTOR_RESTARTING):
             pg_bundle = None
@@ -815,13 +826,11 @@ class GcsServer:
                     spec.resources,
                     label_selector=getattr(spec, "label_selector", None))
             if node is None:
-                if time.monotonic() > deadline:
+                if not await bo.sleep():
                     await self._actor_dead(
                         info, "no node with required resources "
                         f"{dict(spec.resources)}")
                     return
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, cfg.retry_backoff_max_s)
                 continue
             try:
                 lease = await self._nodelet(node.node_id).call(
@@ -833,8 +842,14 @@ class GcsServer:
                     timeout=cfg.worker_start_timeout_s,
                 )
                 if not lease.get("ok"):
-                    await asyncio.sleep(backoff)
-                    backoff = min(backoff * 2, cfg.retry_backoff_max_s)
+                    # Resources busy on the picked node: the actor stays
+                    # pending (another lease may free them). Once the
+                    # backoff deadline is exhausted sleep() returns False
+                    # WITHOUT sleeping — keep pacing at the jittered cap
+                    # (never in lockstep) instead of hot-spinning leases.
+                    if not await bo.sleep():
+                        await asyncio.sleep(
+                            delay_for_attempt(64, maximum=bo.maximum))
                     continue
                 worker_addr = tuple(lease["worker_address"])
                 worker_client = RpcClient(*worker_addr, name="actor-worker")
@@ -863,9 +878,7 @@ class GcsServer:
             except Exception as e:
                 logger.warning("actor %s scheduling attempt failed: %r",
                                info.actor_id, e)
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, cfg.retry_backoff_max_s)
-                if time.monotonic() > deadline:
+                if not await bo.sleep():
                     await self._actor_dead(info, f"scheduling failed: {e!r}")
                     return
 
@@ -926,6 +939,12 @@ class GcsServer:
     async def rpc_list_actors(self) -> List[Dict[str, Any]]:
         return [a.public_view() for a in self.actors.values()]
 
+    # Tombstones older than this can't belong to an in-flight registration
+    # (the register pipeline is bounded by worker_start_timeout_s + RPC
+    # retries); the cap is a backstop against kill floods of bogus ids.
+    PREKILL_TTL_S = 300.0
+    PREKILL_MAX = 4096
+
     async def rpc_kill_actor(self, actor_id: bytes,
                              no_restart: bool = True) -> Dict[str, Any]:
         info = self.actors.get(ActorID(actor_id))
@@ -934,7 +953,14 @@ class GcsServer:
             # legitimately arrive BEFORE register_actor. Tombstone the id
             # so the late registration lands dead instead of leaking a
             # zombie nobody holds a handle to.
-            self._prekilled.add(ActorID(actor_id))
+            now = time.monotonic()
+            self._prekilled.pop(ActorID(actor_id), None)  # refresh order
+            self._prekilled[ActorID(actor_id)] = now
+            for aid, ts in list(self._prekilled.items()):
+                if (now - ts <= self.PREKILL_TTL_S
+                        and len(self._prekilled) <= self.PREKILL_MAX):
+                    break
+                del self._prekilled[aid]
             return {"ok": False, "error": "no such actor"}
         # Reply as soon as the kill is ACCEPTED (reference: ray.kill is
         # asynchronous); the FSM transition + worker exit proceed on this

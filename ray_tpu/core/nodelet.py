@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private.chaos import get_chaos
 from ray_tpu._private.ids import NodeID, WorkerID
 from ray_tpu._private.rpc import RpcClient, RpcServer
 from ray_tpu._private.task_spec import ResourceSet
@@ -559,6 +560,7 @@ class Nodelet:
                        and self._zygote_proc.poll() is None):
                     time.sleep(0.01)
         try:
+            get_chaos().failpoint("nodelet.zygote_fork")
             return spawn_via_zygote(self._zygote_sock, env, log_path)
         except Exception:
             logger.warning("zygote spawn failed; falling back to exec",
@@ -681,6 +683,15 @@ class Nodelet:
             if pool is None:
                 return {"ok": False, "error": "unknown placement bundle"}
             if req.fits_in(pool):
+                # Failpoint BEFORE any accounting mutates: an injected
+                # grant failure/delay must never leak reserved resources.
+                # The await yields the loop, so re-check fitness after —
+                # a concurrent grant may have taken the resources.
+                chaos = get_chaos()
+                if chaos.enabled:
+                    await chaos.failpoint_async("nodelet.lease_grant")
+                    if not req.fits_in(pool):
+                        continue
                 req.subtract_from(pool)
                 self._bump_resources()
                 # Disjoint chip assignment per whole-chip lease; fractional
@@ -1221,12 +1232,17 @@ class Nodelet:
         cfg = get_config()
         while not self._shutting_down:
             try:
+                # Timeout near the beat period, not gcs_rpc_timeout_s: if
+                # the GCS received the beat but the ack is lost (one-way
+                # partition), a 30s stall here would miss enough beats to
+                # get this node declared dead even though its beats arrive.
                 reply = await self._gcs.call(
                     "heartbeat",
                     node_id=self.node_id.binary(),
                     resources_available=dict(self.resources_available),
                     demand=self._demand_snapshot(),
                     version=self._resource_version,
+                    timeout=max(2 * cfg.heartbeat_interval_s, 2.0),
                 )
                 if not reply.get("ok") and reply.get("reregister"):
                     # GCS declared us dead (transient stall past the failure

@@ -449,6 +449,11 @@ def spill_path(spill_dir: str, object_id: ObjectID) -> str:
 
 def spill_write(spill_dir: str, object_id: ObjectID,
                 obj: SerializedObject) -> str:
+    # Chaos seam: injected failure behaves exactly like a full/readonly
+    # spill disk (the write-then-rename below guarantees no torn file).
+    from ray_tpu._private.chaos import get_chaos
+
+    get_chaos().failpoint("object_store.spill")
     os.makedirs(spill_dir, exist_ok=True)
     path = spill_path(spill_dir, object_id)
     tmp = path + ".tmp"

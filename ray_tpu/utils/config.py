@@ -84,9 +84,12 @@ class RayTpuConfig:
     # --- fault tolerance ---
     task_max_retries: int = 3
     actor_max_restarts: int = 0
-    # Exponential backoff for actor/task retry.
+    # Unified retry policy (_private/backoff.py): exponential backoff with
+    # full jitter, capped at retry_backoff_max_s, bounded by an overall
+    # per-burst deadline. <=0 deadline means unbounded.
     retry_backoff_initial_s: float = 0.1
     retry_backoff_max_s: float = 10.0
+    retry_deadline_s: float = 120.0
 
     # --- memory monitor / OOM (reference: memory_monitor.h + C19 worker
     # killing policies) ---
@@ -102,9 +105,20 @@ class RayTpuConfig:
     # cgroup hierarchy isn't writable.
     enable_worker_cgroups: bool = True
 
-    # --- chaos / testing (reference: rpc_chaos.h, asio_chaos.cc) ---
-    # "method:failure_prob" comma list, e.g. "push_task:0.1,lease:0.05".
+    # --- chaos / testing (_private/chaos.py; reference: rpc_chaos.h,
+    # asio_chaos.cc). docs/operations.md documents the grammar.
+    # "key:failure_prob" comma list over RPC methods AND failpoint names,
+    # e.g. "push_task:0.1,gcs.snapshot_save:0.05".
     testing_rpc_failure: str = ""
+    # Seed for the deterministic fault schedule; 0 = nondeterministic.
+    chaos_seed: int = 0
+    # Latency injection: "pattern=min_ms:max_ms[:prob]" comma list with
+    # fnmatch patterns over <method>, server.<method>, recv.<method> and
+    # failpoint names, e.g. "*lease_worker=5:50,push_task=0:20:0.5".
+    chaos_delay_ms: str = ""
+    # One-way partitions: "method[@peer]:send|recv|both[:prob]" comma
+    # list, e.g. "heartbeat:recv" (beats reach GCS, acks vanish).
+    chaos_partition: str = ""
     # Force the memory monitor's usage reading (tests).
     testing_memory_usage: float = -1.0
 

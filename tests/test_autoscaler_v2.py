@@ -87,3 +87,39 @@ def test_allocation_failure_is_terminal(ray_start_regular):
     assert "quota" in views[0]["error"]
     # terminal instances never consume the live budget
     assert scaler.summary()["live"] == 0
+
+
+def test_sync_reality_tolerates_value_equal_provider_handles():
+    """Regression (ADVICE r5): _sync_reality keyed provider nodes by
+    Python id(), so a provider that rebuilds equal-value handles per
+    nodes() call (natural for cloud list APIs) made every RAY_RUNNING
+    instance look 'provider lost' and TERMINATED healthy nodes."""
+    from ray_tpu.autoscaler import NodeProvider
+
+    NID = b"\x01" * 16
+
+    class Handle:
+        def __init__(self):
+            self.node_id = NID
+
+    class RebuildingProvider(NodeProvider):
+        def create_node(self, resources):
+            return Handle()
+
+        def terminate_node(self, node):
+            pass
+
+        def nodes(self):
+            return [Handle()]  # fresh value-equal objects every call
+
+    from ray_tpu.autoscaler_v2 import ALLOCATED, REQUESTED
+
+    scaler = AutoscalerV2(RebuildingProvider(), max_workers=2)
+    inst = scaler.instances.add({"CPU": 1.0})
+    inst.set_state(REQUESTED)
+    inst.node = Handle()  # a third distinct object, same node_id
+    inst.set_state(ALLOCATED)
+    inst.set_state(RAY_RUNNING)
+    for _ in range(3):
+        scaler._sync_reality()
+    assert inst.state == RAY_RUNNING, inst.view()
