@@ -518,10 +518,17 @@ class ActorSubmitter:
                 # Long-running pinned loops (compiled-DAG channels) must
                 # not occupy the fast lane's sequential connection — they
                 # reply only at teardown, which would head-of-line block
-                # every later call. Ship them via the control lane.
+                # every later call. The same applies to any call in a named
+                # concurrency group (e.g. serve's routing long-poll, which
+                # parks server-side for its full poll window): a shared
+                # push_actor_task_batch frame replies only after *all*
+                # members finish, so batching a parked poll with a fast
+                # call stalls the fast call for the poll window. Ship both
+                # via the control lane, one frame per call.
                 pinned = [it for it in batch
                           if it[0].actor_method_name
-                          == "__dag_channel_loop__"]
+                          == "__dag_channel_loop__"
+                          or it[0].concurrency_group]
                 if pinned:
                     batch = [it for it in batch if it not in pinned]
                     ctl = self.control_client or client
@@ -2738,8 +2745,22 @@ class Worker:
             finally:
                 self._current_task_id = None
         loop = asyncio.get_running_loop()
-        executor = self._actor_executors.get(
-            task_spec.concurrency_group) or self._actor_executors[""]
+        if task_spec.concurrency_group:
+            # Named concurrency groups get their own single-thread lane
+            # (reference: actor concurrency groups), created lazily per
+            # group name. Like the dag-loop thread above, they bypass the
+            # exec lock on purpose: a parked long-poll in a group must not
+            # serialize against — or starve — normal-lane execution on a
+            # max_concurrency=1 actor.
+            executor = self._actor_executors.get(task_spec.concurrency_group)
+            if executor is None:
+                executor = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1,
+                    thread_name_prefix=f"cg-{task_spec.concurrency_group}")
+                self._actor_executors[task_spec.concurrency_group] = executor
+            return await loop.run_in_executor(
+                executor, self._execute_actor_task_sync, task_spec, method)
+        executor = self._actor_executors[""]
         if os.environ.get("RAY_TPU_PUSH_TRACE"):
             tpre = time.perf_counter_ns()
             reply = await loop.run_in_executor(

@@ -12,24 +12,33 @@ from ray_tpu._private.ids import ActorID
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1, tensor_transport: str = ""):
+                 num_returns: int = 1, tensor_transport: str = "",
+                 concurrency_group: str = ""):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
         self._tensor_transport = tensor_transport
+        self._concurrency_group = concurrency_group
 
     def options(self, num_returns: Optional[int] = None,
                 tensor_transport: Optional[str] = None,
+                concurrency_group: Optional[str] = None,
                 **_ignored) -> "ActorMethod":
         """tensor_transport="device" keeps returned jax.Arrays in the actor's
         HBM (reference: @ray.method(tensor_transport=...), RDT); see
-        ray_tpu.experimental.device_objects. None means "keep the current
-        setting" so chained .options() calls compose."""
+        ray_tpu.experimental.device_objects. concurrency_group names an
+        isolated submission/execution lane (reference: actor concurrency
+        groups): calls in a group never share a batched reply frame with
+        ungrouped calls, so a parked long-poll cannot head-of-line block
+        them. None means "keep the current setting" so chained .options()
+        calls compose."""
         return ActorMethod(
             self._handle, self._method_name,
             self._num_returns if num_returns is None else num_returns,
             self._tensor_transport if tensor_transport is None
-            else tensor_transport)
+            else tensor_transport,
+            self._concurrency_group if concurrency_group is None
+            else concurrency_group)
 
     def bind(self, *args, **kwargs):
         """Build a DAG node from this method (reference: dag/dag_node.py)."""
@@ -49,6 +58,7 @@ class ActorMethod:
             kwargs,
             num_returns=num_returns,
             max_task_retries=self._handle._max_task_retries,
+            concurrency_group=self._concurrency_group,
             tensor_transport=self._tensor_transport,
         )
         if num_returns in (1, -1):

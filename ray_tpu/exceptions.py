@@ -38,6 +38,30 @@ class ActorUnavailableError(RayActorError):
     """Actor temporarily unreachable (e.g. restarting)."""
 
 
+class BackPressureError(RayTpuError):
+    """Request shed because a capacity bound was hit: the replica is at
+    ``max_ongoing_requests`` (or draining for shutdown), the handle's
+    pending queue is at ``max_queued_requests``, or the proxy is at its
+    admission ceiling. Retryable after backoff — Serve ingress maps it to
+    HTTP 429 with a ``Retry-After`` header (reference: SEDA adaptive
+    admission control / DAGOR overload control: shed explicitly at every
+    queueing stage instead of collapsing under queueing delay)."""
+
+
+class NoHealthyReplicasError(RayActorError):
+    """A serve deployment currently has zero healthy replicas to route
+    to. Serve ingress maps it to HTTP 503 + ``Retry-After``."""
+
+
+def unwrap_backpressure(exc: BaseException) -> Optional["BackPressureError"]:
+    """Return the BackPressureError carried by ``exc`` (directly, or as the
+    ``cause`` of a RayTaskError crossing the task boundary), else None."""
+    if isinstance(exc, BackPressureError):
+        return exc
+    cause = getattr(exc, "cause", None)
+    return cause if isinstance(cause, BackPressureError) else None
+
+
 class TaskCancelledError(RayTpuError):
     pass
 

@@ -1,0 +1,230 @@
+#!/usr/bin/env python3
+"""gRPC stub drift lint: the hand-maintained serve_grpc_pb2*.py files
+must stay consistent with serve_grpc.proto.
+
+serve_grpc_pb2.py is protoc output and serve_grpc_pb2_grpc.py is
+maintained BY HAND in the grpc-python codegen shape (the dev image has
+neither protoc nor the grpc python plugin). Nothing stops an rpc added
+to the .proto from silently never reaching the stubs — clients would
+get UNIMPLEMENTED at runtime with no build-time signal. This check
+closes that gap three ways:
+
+1. parse serve_grpc.proto (proto3 subset: flat messages, one service)
+   into a structural spec;
+2. decode the FileDescriptorProto embedded in serve_grpc_pb2.py and
+   demand the same packages, messages, field numbers/labels, rpcs and
+   streaming shapes;
+3. lint serve_grpc_pb2_grpc.py source: every rpc needs a Stub channel
+   registration, a Servicer method, and a method-handler entry, each of
+   the kind (unary_unary / unary_stream / ...) the .proto declares.
+
+When grpc_tools IS importable (CI installs grpcio-tools; the dev image
+does not), it additionally regenerates the message module and diffs the
+generated descriptor against the checked-in one byte-for-byte.
+
+Exit 0 = stubs match; exit 1 lists every divergence. Wired into CI
+(.github/workflows/ci.yml, `grpc-stub-contract` step).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Tuple
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_DIR = os.path.join(PKG_ROOT, "serve")
+PROTO_PATH = os.path.join(SERVE_DIR, "serve_grpc.proto")
+PB2_MODULE = "ray_tpu.serve.serve_grpc_pb2"
+PB2_GRPC_PATH = os.path.join(SERVE_DIR, "serve_grpc_pb2_grpc.py")
+
+# spec shapes:
+#   messages: {msg_name: {field_name: (number, repeated)}}
+#   rpcs:     {rpc_name: (request, response, client_stream, server_stream)}
+Messages = Dict[str, Dict[str, Tuple[int, bool]]]
+Rpcs = Dict[str, Tuple[str, str, bool, bool]]
+
+_COMMENT_RE = re.compile(r"//[^\n]*|/\*.*?\*/", re.S)
+_MSG_RE = re.compile(r"message\s+(\w+)\s*\{([^{}]*)\}", re.S)
+_FIELD_RE = re.compile(
+    r"(repeated\s+)?([\w.]+)\s+(\w+)\s*=\s*(\d+)\s*;")
+_SVC_RE = re.compile(r"service\s+(\w+)\s*\{(.*?)\}", re.S)
+_RPC_RE = re.compile(
+    r"rpc\s+(\w+)\s*\(\s*(stream\s+)?([\w.]+)\s*\)\s*"
+    r"returns\s*\(\s*(stream\s+)?([\w.]+)\s*\)", re.S)
+
+
+def parse_proto(path: "str | None" = None):
+    """(package, service_name, messages, rpcs) from the .proto text."""
+    text = _COMMENT_RE.sub("", open(path or PROTO_PATH).read())
+    pkg_m = re.search(r"package\s+([\w.]+)\s*;", text)
+    package = pkg_m.group(1) if pkg_m else ""
+    # Service blocks contain no nested braces; strip them before message
+    # parsing so rpc argument types are not misread as fields.
+    services = _SVC_RE.findall(text)
+    msg_text = _SVC_RE.sub("", text)
+    messages: Messages = {}
+    for name, body in _MSG_RE.findall(msg_text):
+        messages[name] = {
+            f: (int(num), bool(rep))
+            for rep, _type, f, num in _FIELD_RE.findall(body)}
+    if len(services) != 1:
+        raise ValueError(f"expected exactly one service, got "
+                         f"{[s[0] for s in services]}")
+    svc_name, svc_body = services[0]
+    rpcs: Rpcs = {}
+    for name, c_stream, req, s_stream, resp in _RPC_RE.findall(svc_body):
+        rpcs[name] = (req.split(".")[-1], resp.split(".")[-1],
+                      bool(c_stream), bool(s_stream))
+    return package, svc_name, messages, rpcs
+
+
+def _descriptor_spec(serialized_pb: bytes):
+    """Same structural projection, from a FileDescriptorProto blob."""
+    from google.protobuf import descriptor_pb2
+
+    fdp = descriptor_pb2.FileDescriptorProto.FromString(serialized_pb)
+    messages: Messages = {}
+    for msg in fdp.message_type:
+        messages[msg.name] = {
+            f.name: (f.number,
+                     f.label == f.LABEL_REPEATED)
+            for f in msg.field}
+    if len(fdp.service) != 1:
+        raise ValueError(f"descriptor has {len(fdp.service)} services")
+    svc = fdp.service[0]
+    rpcs: Rpcs = {
+        m.name: (m.input_type.split(".")[-1], m.output_type.split(".")[-1],
+                 m.client_streaming, m.server_streaming)
+        for m in svc.method}
+    return fdp.package, svc.name, messages, rpcs
+
+
+def _handler_kind(client_stream: bool, server_stream: bool) -> str:
+    return ("stream" if client_stream else "unary") + "_" + \
+        ("stream" if server_stream else "unary")
+
+
+def _check_pb2(problems: List[str]) -> None:
+    import importlib
+
+    pb2 = importlib.import_module(PB2_MODULE)
+    want = parse_proto()
+    got = _descriptor_spec(pb2.DESCRIPTOR.serialized_pb)
+    for label, w, g in (("package", want[0], got[0]),
+                        ("service name", want[1], got[1])):
+        if w != g:
+            problems.append(f"pb2 {label}: proto={w!r} pb2={g!r}")
+    w_msgs, g_msgs = want[2], got[2]
+    for name in sorted(set(w_msgs) ^ set(g_msgs)):
+        where = "proto" if name in w_msgs else "pb2"
+        problems.append(f"message {name} only in {where}")
+    for name in sorted(set(w_msgs) & set(g_msgs)):
+        if w_msgs[name] != g_msgs[name]:
+            problems.append(
+                f"message {name} fields diverge: proto={w_msgs[name]} "
+                f"pb2={g_msgs[name]}")
+    w_rpcs, g_rpcs = want[3], got[3]
+    for name in sorted(set(w_rpcs) ^ set(g_rpcs)):
+        where = "proto" if name in w_rpcs else "pb2"
+        problems.append(f"rpc {name} only in {where}")
+    for name in sorted(set(w_rpcs) & set(g_rpcs)):
+        if w_rpcs[name] != g_rpcs[name]:
+            problems.append(
+                f"rpc {name} diverges: proto={w_rpcs[name]} "
+                f"pb2={g_rpcs[name]}")
+
+
+def _check_pb2_grpc(problems: List[str]) -> None:
+    src = open(PB2_GRPC_PATH).read()
+    _, svc_name, _, rpcs = parse_proto()
+    for cls in (f"{svc_name}Stub", f"{svc_name}Servicer"):
+        if f"class {cls}" not in src:
+            problems.append(f"pb2_grpc missing class {cls}")
+    if f"def add_{svc_name}Servicer_to_server" not in src:
+        problems.append(
+            f"pb2_grpc missing add_{svc_name}Servicer_to_server")
+    for name, (req, resp, c_stream, s_stream) in sorted(rpcs.items()):
+        kind = _handler_kind(c_stream, s_stream)
+        stub_m = re.search(
+            rf"self\.{name}\s*=\s*channel\.(\w+)\(", src)
+        if stub_m is None:
+            problems.append(f"pb2_grpc Stub does not register rpc {name}")
+        elif stub_m.group(1) != kind:
+            problems.append(
+                f"pb2_grpc Stub registers {name} as {stub_m.group(1)}, "
+                f"proto says {kind}")
+        if re.search(
+                rf"def\s+{name}\s*\(\s*self,\s*request", src) is None:
+            problems.append(f"pb2_grpc Servicer lacks method {name}")
+        handler_m = re.search(
+            rf'"{name}"\s*:\s*grpc\.(\w+)_rpc_method_handler', src)
+        if handler_m is None:
+            problems.append(f"pb2_grpc has no method handler for {name}")
+        elif handler_m.group(1) != kind:
+            problems.append(
+                f"pb2_grpc handler for {name} is {handler_m.group(1)}, "
+                f"proto says {kind}")
+        for msg in (req, resp):
+            if msg not in src:
+                problems.append(
+                    f"pb2_grpc never references message {msg} "
+                    f"(used by rpc {name})")
+
+
+def _check_codegen_diff(problems: List[str]) -> bool:
+    """Regenerate with grpc_tools when available and byte-compare the
+    descriptor. Returns False when grpc_tools is absent (structural
+    checks above already ran)."""
+    try:
+        from grpc_tools import protoc
+    except ImportError:
+        return False
+    import importlib
+    import tempfile
+
+    pb2 = importlib.import_module(PB2_MODULE)
+    with tempfile.TemporaryDirectory() as td:
+        rc = protoc.main([
+            "protoc", f"-I{SERVE_DIR}", f"--python_out={td}",
+            os.path.join(SERVE_DIR, "serve_grpc.proto")])
+        if rc != 0:
+            problems.append(f"grpc_tools.protoc exited {rc}")
+            return True
+        gen = open(os.path.join(td, "serve_grpc_pb2.py")).read()
+        m = re.search(
+            r"AddSerializedFile\(\s*(b(?:'(?:[^'\\]|\\.)*'"
+            r"|\"(?:[^\"\\]|\\.)*\"))", gen)
+        if m is None:
+            problems.append("generated pb2 has no AddSerializedFile blob")
+            return True
+        blob = ast.literal_eval(m.group(1))
+        if _descriptor_spec(blob) != _descriptor_spec(
+                pb2.DESCRIPTOR.serialized_pb):
+            problems.append(
+                "checked-in serve_grpc_pb2.py descriptor diverges from "
+                "freshly generated output — regenerate it from "
+                "serve_grpc.proto")
+    return True
+
+
+def main() -> int:
+    problems: List[str] = []
+    _check_pb2(problems)
+    _check_pb2_grpc(problems)
+    regenerated = _check_codegen_diff(problems)
+    if problems:
+        print("gRPC stub drift detected:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    mode = "codegen diff" if regenerated else "structural check"
+    print(f"gRPC stubs match serve_grpc.proto ({mode}).")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(PKG_ROOT))
+    sys.exit(main())

@@ -40,6 +40,9 @@ class Deployment:
                  num_replicas: int = 1,
                  ray_actor_options: Optional[Dict[str, Any]] = None,
                  max_ongoing_requests: int = 16,
+                 max_queued_requests: int = 64,
+                 request_timeout_s: float = 60.0,
+                 graceful_shutdown_timeout_s: float = 10.0,
                  user_config: Optional[Dict[str, Any]] = None,
                  route_prefix: Optional[str] = None,
                  autoscaling_config: Optional[Dict[str, Any]] = None,
@@ -49,6 +52,9 @@ class Deployment:
         self.num_replicas = num_replicas
         self.ray_actor_options = ray_actor_options or {}
         self.max_ongoing_requests = max_ongoing_requests
+        self.max_queued_requests = max_queued_requests
+        self.request_timeout_s = request_timeout_s
+        self.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
         self.user_config = user_config
         self.route_prefix = route_prefix
         self.autoscaling_config = autoscaling_config
@@ -59,6 +65,9 @@ class Deployment:
             name=self.name, num_replicas=self.num_replicas,
             ray_actor_options=self.ray_actor_options,
             max_ongoing_requests=self.max_ongoing_requests,
+            max_queued_requests=self.max_queued_requests,
+            request_timeout_s=self.request_timeout_s,
+            graceful_shutdown_timeout_s=self.graceful_shutdown_timeout_s,
             user_config=self.user_config, route_prefix=self.route_prefix,
             autoscaling_config=self.autoscaling_config,
             request_router=self.request_router)
@@ -92,7 +101,9 @@ def start(http_port: int = 0, _with_http: bool = True,
     serve.start(grpc_options=gRPCOptions(...)); 0 picks a free port —
     read it back with serve.grpc_port())."""
     from ray_tpu.serve._controller import ServeController
+    from ray_tpu.serve._handle import reset_shutdown
 
+    reset_shutdown()  # new lifecycle: handle long-poll threads may run
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
@@ -153,6 +164,9 @@ def run(target: Application, *, name: str = "default",
             dict(num_replicas=dep.num_replicas,
                  ray_actor_options=dep.ray_actor_options,
                  max_ongoing_requests=dep.max_ongoing_requests,
+                 max_queued_requests=dep.max_queued_requests,
+                 request_timeout_s=dep.request_timeout_s,
+                 graceful_shutdown_timeout_s=dep.graceful_shutdown_timeout_s,
                  user_config=dep.user_config,
                  route_prefix=prefix,
                  autoscaling_config=dep.autoscaling_config,
@@ -207,10 +221,23 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
+    from ray_tpu.serve._handle import signal_shutdown
+
+    # Latch first: every handle's long-poll thread must exit instead of
+    # retrying a controller that is gone for good.
+    signal_shutdown()
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
         return
+    # Drain-aware ingress shutdown: the proxy closes its listener FIRST,
+    # then finishes in-flight requests, so no accepted request is cut off
+    # mid-flight by the kill below.
+    try:
+        proxy = ray_tpu.get_actor("SERVE_PROXY")
+        ray_tpu.get(proxy.drain.remote(5.0), timeout=30)
+    except Exception:
+        pass
     try:
         ray_tpu.get(controller.shutdown_all.remote(), timeout=60)
     except Exception:

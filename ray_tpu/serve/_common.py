@@ -16,6 +16,16 @@ class DeploymentConfig:
     num_replicas: int = 1
     ray_actor_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     max_ongoing_requests: int = 16
+    # Overload control (reference: serve/config.py DeploymentConfig +
+    # HTTPOptions.request_timeout_s). max_queued_requests bounds how many
+    # shed requests each handle will hold in its retry queue before
+    # propagating BackPressureError to the caller; request_timeout_s is the
+    # end-to-end budget ingress enforces (expiry -> 504);
+    # graceful_shutdown_timeout_s is how long the controller waits for a
+    # draining replica's in-flight requests before killing it.
+    max_queued_requests: int = 64
+    request_timeout_s: float = 60.0
+    graceful_shutdown_timeout_s: float = 10.0
     route_prefix: Optional[str] = None
     version: int = 0
     user_config: Optional[Dict[str, Any]] = None

@@ -62,11 +62,11 @@ class ServeController:
 
     def delete_deployment(self, name: str) -> None:
         with self._lock:
-            self._deployments.pop(name, None)
+            d = self._deployments.pop(name, None)
             victims = self._replicas.pop(name, [])
             self._version += 1
-        for info in victims:
-            self._kill(info)
+        grace = (d["config"].graceful_shutdown_timeout_s if d else 5.0)
+        self._drain_and_kill(victims, grace)
 
     def shutdown_all(self) -> None:
         with self._lock:
@@ -112,6 +112,10 @@ class ServeController:
                         "route_prefix": d["config"].route_prefix,
                         "max_ongoing_requests":
                             d["config"].max_ongoing_requests,
+                        "max_queued_requests":
+                            d["config"].max_queued_requests,
+                        "request_timeout_s":
+                            d["config"].request_timeout_s,
                         "request_router": d["config"].request_router,
                     }
                     for name, d in self._deployments.items()
@@ -220,10 +224,13 @@ class ServeController:
             if health_check:
                 self._autoscale(name, cfg, replicas)
                 for info in list(replicas):
+                    was_healthy = info.healthy
                     try:
                         ray_tpu.get(info.actor.check_health.remote(),
                                     timeout=10)
                         info.healthy = True
+                        if not was_healthy:
+                            changed = True  # back in routing: push the news
                     except Exception as e:
                         # Startup grace: a replica still waiting on worker
                         # spawn + model load (ActorUnavailable / pending)
@@ -238,6 +245,10 @@ class ServeController:
                         dead = isinstance(e, ActorDiedError)
                         if not dead and age < 180.0:
                             info.healthy = False
+                            if was_healthy:
+                                # Routing filters on healthy: push the
+                                # change or proxies keep sending traffic.
+                                changed = True
                             logger.info(
                                 "replica %s of %s not ready yet "
                                 "(%.0fs): %r", info.replica_id, name,
@@ -249,7 +260,11 @@ class ServeController:
                         with self._lock:
                             if info in replicas:
                                 replicas.remove(info)
-                        self._kill(info)
+                            # Routing must drop the victim BEFORE the drain
+                            # so handles stop picking it while it finishes.
+                            self._version += 1
+                        self._drain_and_kill(
+                            [info], cfg.graceful_shutdown_timeout_s)
                         changed = True
             while len(replicas) < cfg.num_replicas:
                 rid = f"{name}#{uuid.uuid4().hex[:6]}"
@@ -258,9 +273,14 @@ class ServeController:
                 actor = Actor.options(
                     num_cpus=opts.get("num_cpus", 1.0),
                     num_tpus=opts.get("num_tpus") or None,
-                    max_concurrency=max(1, cfg.max_ongoing_requests),
+                    # Headroom over the admission cap: over-capacity calls
+                    # must still EXECUTE (to raise BackPressureError fast)
+                    # rather than park in the actor mailbox, and health /
+                    # drain control calls need slots while the replica is
+                    # saturated with user requests.
+                    max_concurrency=max(2, cfg.max_ongoing_requests * 2),
                 ).remote(d["ctor"], tuple(d["args"]), dict(d["kwargs"]),
-                         cfg.user_config, name)
+                         cfg.user_config, name, cfg.max_ongoing_requests)
                 with self._lock:
                     replicas.append(ReplicaInfo(rid, actor))
                 changed = True
@@ -268,7 +288,9 @@ class ServeController:
             while len(replicas) > cfg.num_replicas:
                 with self._lock:
                     info = replicas.pop()
-                self._kill(info)
+                    self._version += 1  # un-route before draining
+                self._drain_and_kill([info],
+                                     cfg.graceful_shutdown_timeout_s)
                 changed = True
         if changed:
             with self._lock:
@@ -287,6 +309,36 @@ class ServeController:
             tag_keys=("deployment",),
         ).set_many([({"deployment": name}, float(n))
                     for name, n in counts.items()])
+
+    def _drain_and_kill(self, infos: List[ReplicaInfo],
+                        grace_s: float) -> None:
+        """Graceful teardown (reference: replica.py
+        perform_graceful_shutdown): each victim stops admitting — new
+        requests shed with BackPressureError, so handles re-route them to
+        surviving replicas — and we wait out its in-flight requests before
+        the kill. Callers must already have bumped the routing version with
+        the victim removed. Drains fan out in parallel; a dead or wedged
+        replica just falls through to the kill."""
+        refs = []
+        for info in infos:
+            try:
+                refs.append(
+                    (info,
+                     info.actor.prepare_for_shutdown.remote(grace_s)))
+            except Exception:
+                refs.append((info, None))
+        for info, ref in refs:
+            if ref is not None:
+                try:
+                    left = ray_tpu.get(ref, timeout=grace_s + 10)
+                    if left:
+                        logger.warning(
+                            "replica %s killed with %d requests still "
+                            "in flight after %.1fs grace",
+                            info.replica_id, left, grace_s)
+                except Exception:
+                    pass
+            self._kill(info)
 
     def _kill(self, info: ReplicaInfo) -> None:
         try:

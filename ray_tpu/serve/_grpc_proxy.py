@@ -16,10 +16,34 @@ import json
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    NoHealthyReplicasError,
+    RayActorError,
+    unwrap_backpressure,
+)
 from ray_tpu.serve._common import CONTROLLER_NAME
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+
+def _grpc_overload_status(e: BaseException):
+    """(grpc.StatusCode, shed_reason) for overload-control failures, or
+    (None, None) for everything else — mirrors the HTTP proxy's
+    429/504/503 contract on the gRPC plane."""
+    import grpc
+
+    if unwrap_backpressure(e) is not None:
+        return grpc.StatusCode.RESOURCE_EXHAUSTED, "backpressure"
+    if isinstance(e, (GetTimeoutError, asyncio.TimeoutError, TimeoutError)):
+        return grpc.StatusCode.DEADLINE_EXCEEDED, "timeout"
+    if isinstance(e, NoHealthyReplicasError):
+        return grpc.StatusCode.UNAVAILABLE, "no_replica"
+    if isinstance(e, RayActorError) or isinstance(
+            getattr(e, "cause", None), RayActorError):
+        return grpc.StatusCode.UNAVAILABLE, "replica_died"
+    return None, None
 
 
 def _decode_payload(request) -> Any:
@@ -48,8 +72,22 @@ class GrpcProxyActor:
         self._routes: Dict[str, str] = {}  # route_prefix -> deployment
         self._apps: Dict[str, str] = {}    # app/deployment name -> deployment
         self._handles: Dict[str, Any] = {}
+        self._deployments: Dict[str, Any] = {}  # name -> routing info
         self._version = -1
         self._server = None
+        from ray_tpu.util import metrics as um
+
+        self._m_shed = um.get_counter(
+            "ray_tpu_serve_shed_total",
+            "Serve requests shed by overload control, by stage/reason",
+            tag_keys=("deployment", "reason"))
+
+    def _timeout_for(self, name: str) -> float:
+        info = self._deployments.get(name) or {}
+        try:
+            return float(info.get("request_timeout_s", 60.0))
+        except (TypeError, ValueError):
+            return 60.0
 
     async def start(self) -> int:
         import grpc
@@ -67,12 +105,21 @@ class GrpcProxyActor:
                         grpc.StatusCode.NOT_FOUND,
                         f"no application {request.application!r}")
                 loop = asyncio.get_running_loop()
+                name = handle.deployment_name
+                timeout_s = proxy._timeout_for(name)
                 try:
                     payload = _decode_payload(request)
-                    out = await loop.run_in_executor(
-                        None, lambda: handle.remote(payload).result(
-                            timeout=600))
+                    out = await asyncio.wait_for(
+                        loop.run_in_executor(
+                            None, lambda: handle.remote(payload).result(
+                                timeout=timeout_s)),
+                        timeout_s + 5.0)
                 except Exception as e:  # noqa: BLE001
+                    code, reason = _grpc_overload_status(e)
+                    if code is not None:
+                        proxy._m_shed.inc(tags={"deployment": name,
+                                                "reason": reason})
+                        await context.abort(code, repr(e))
                     await context.abort(grpc.StatusCode.INTERNAL, repr(e))
                 return _encode_payload(out, pb)
 
@@ -83,6 +130,7 @@ class GrpcProxyActor:
                         grpc.StatusCode.NOT_FOUND,
                         f"no application {request.application!r}")
                 loop = asyncio.get_running_loop()
+                name = handle.deployment_name
                 payload = _decode_payload(request)
                 gen = await loop.run_in_executor(
                     None,
@@ -96,10 +144,22 @@ class GrpcProxyActor:
                     except StopIteration:
                         return _END
 
+                first = True
                 while True:
-                    item = await loop.run_in_executor(None, _next)
+                    try:
+                        item = await asyncio.wait_for(
+                            loop.run_in_executor(None, _next),
+                            proxy._timeout_for(name) + 5.0)
+                    except Exception as e:  # noqa: BLE001
+                        code, reason = _grpc_overload_status(e)
+                        if code is not None and first:
+                            proxy._m_shed.inc(tags={"deployment": name,
+                                                    "reason": reason})
+                            await context.abort(code, repr(e))
+                        raise
                     if item is _END:
                         return
+                    first = False
                     yield _encode_payload(item, pb)
 
             async def ListApplications(self, request, context):
@@ -147,6 +207,7 @@ class GrpcProxyActor:
         if routing is None:
             return
         self._version = routing["version"]
+        self._deployments = routing["deployments"]
         apps: Dict[str, str] = {}
         for name, info in routing["deployments"].items():
             if info.get("route_prefix"):

@@ -14,9 +14,27 @@ import time
 from typing import Any, Dict, Optional
 
 import ray_tpu
+from ray_tpu.exceptions import (
+    BackPressureError,
+    NoHealthyReplicasError,
+    unwrap_backpressure,
+)
 from ray_tpu.serve._common import CONTROLLER_NAME
 
 _ROUTING_TTL_S = 2.0
+
+# Serve-wide shutdown latch: serve.shutdown() sets it so every handle's
+# long-poll thread exits instead of spinning forever retrying a controller
+# that is gone for good; serve.start() clears it for the next lifecycle.
+_shutdown_event = threading.Event()
+
+
+def signal_shutdown() -> None:
+    _shutdown_event.set()
+
+
+def reset_shutdown() -> None:
+    _shutdown_event.clear()
 
 
 class _RouterCache:
@@ -25,6 +43,9 @@ class _RouterCache:
         self.deployments: Dict[str, Any] = {}
         self.fetched_at = 0.0
         self.outstanding: Dict[str, int] = {}
+        # Requests parked in backpressure-retry (the handle's bounded
+        # pending queue; see DeploymentConfig.max_queued_requests).
+        self.queued = 0
         # Multiplexing affinity: model_id -> replica_id last used for it
         # (reference: the router prefers replicas with the model loaded).
         self.model_replica: Dict[str, str] = {}
@@ -33,17 +54,38 @@ class _RouterCache:
 
 
 class DeploymentResponse:
-    """Future-like wrapper over the underlying ObjectRef(s)."""
+    """Future-like wrapper over the underlying ObjectRef(s).
 
-    def __init__(self, ref, handle: "DeploymentHandle", replica_id: str):
+    Backpressure contract: a replica at max_ongoing_requests sheds with
+    BackPressureError instead of queueing. result() absorbs those sheds —
+    the request enters the handle's bounded pending queue and is retried
+    against a freshly pow-2-picked replica with jittered backoff — and
+    re-raises BackPressureError to the caller only once the queue is full
+    or the deadline passes (reference: router retry + SEDA admission)."""
+
+    def __init__(self, ref, handle: "DeploymentHandle", replica_id: str,
+                 call_args: tuple = (), call_kwargs: Optional[dict] = None):
         self._ref = ref
         self._handle = handle
         self._replica_id = replica_id
+        self._call_args = call_args
+        self._call_kwargs = call_kwargs or {}
         self._done = False
 
     def result(self, timeout: Optional[float] = None) -> Any:
+        deadline = (time.monotonic() + timeout
+                    if timeout is not None else None)
         try:
-            return ray_tpu.get(self._ref, timeout=timeout)
+            remaining = (None if deadline is None
+                         else max(0.0, deadline - time.monotonic()))
+            return ray_tpu.get(self._ref, timeout=remaining)
+        except Exception as e:
+            if unwrap_backpressure(e) is None:
+                raise
+            self._finish()  # release the shed attempt's outstanding slot
+            out, self._ref, self._replica_id = self._handle._retry_shed(
+                self._call_args, self._call_kwargs, deadline, e)
+            return out
         finally:
             self._finish()
 
@@ -61,18 +103,51 @@ class DeploymentResponse:
 
 
 class DeploymentResponseGenerator:
-    """Streaming response: iterate the replica's generator items."""
+    """Streaming response: iterate the replica's generator items. A shed
+    (BackPressureError before the first item) re-picks a replica through
+    the same bounded-queue retry path as unary calls."""
 
-    def __init__(self, gen, handle: "DeploymentHandle", replica_id: str):
+    def __init__(self, gen, handle: "DeploymentHandle", replica_id: str,
+                 call_args: tuple = (), call_kwargs: Optional[dict] = None):
         self._gen = gen
         self._handle = handle
         self._replica_id = replica_id
+        self._call_args = call_args
+        self._call_kwargs = call_kwargs or {}
         self._done = False
 
     def __iter__(self):
+        attempts = 0
+        deadline = None
         try:
-            for ref in self._gen:
-                yield ray_tpu.get(ref)
+            first = True
+            it = iter(self._gen)
+            while True:
+                try:
+                    ref = next(it)
+                except StopIteration:
+                    return
+                try:
+                    item = ray_tpu.get(ref)
+                except Exception as e:
+                    if not first or unwrap_backpressure(e) is None:
+                        raise
+                    # Shed before any output: retry on another replica.
+                    self._handle._dec(self._replica_id)
+                    self._done = True  # old slot released; guard finally
+                    if deadline is None:
+                        deadline = (time.monotonic()
+                                    + self._handle._request_timeout_s())
+                    rid2, gen2 = self._handle._retry_shed_stream(
+                        self._call_args, self._call_kwargs, deadline,
+                        attempts, e)
+                    self._done = False
+                    attempts += 1
+                    self._gen, self._replica_id = gen2, rid2
+                    it = iter(self._gen)
+                    continue
+                first = False
+                yield item
         finally:
             if not self._done:
                 self._done = True
@@ -123,22 +198,38 @@ class DeploymentHandle:
 
     def _poll_loop(self) -> None:
         c = self._cache
-        while True:
-            try:
-                if not ray_tpu.is_initialized():
+        try:
+            while True:
+                if _shutdown_event.is_set() or not ray_tpu.is_initialized():
                     return
-                controller = ray_tpu.get_actor(CONTROLLER_NAME)
-                routing = ray_tpu.get(
-                    controller.wait_routing.remote(c.version, 25.0),
-                    timeout=40)
-                if routing is not None:
-                    with c.lock:
-                        c.version = routing["version"]
-                        c.deployments = routing["deployments"]
-                        c.fetched_at = time.monotonic()
-            except Exception:
-                # controller restarting / shutdown: back off, retry
-                time.sleep(1.0)
+                try:
+                    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                    # The long-poll parks in the controller for up to 25s.
+                    # It MUST ride its own submission lane: batched with an
+                    # ordinary call (get_http_port, deploy, ...) the shared
+                    # reply frame would hold that call hostage for the full
+                    # poll window.
+                    routing = ray_tpu.get(
+                        controller.wait_routing.options(
+                            concurrency_group="_serve_longpoll",
+                        ).remote(c.version, 25.0),
+                        timeout=40)
+                    if routing is not None:
+                        with c.lock:
+                            c.version = routing["version"]
+                            c.deployments = routing["deployments"]
+                            c.fetched_at = time.monotonic()
+                except Exception:
+                    # Controller restarting: back off, retry — but a
+                    # serve.shutdown() means it is gone for GOOD; without
+                    # the latch check this thread would spin forever.
+                    if _shutdown_event.wait(1.0):
+                        return
+        finally:
+            # Allow a later serve.start() to restart the poller on this
+            # (cached, shared) router state.
+            with c.lock:
+                c.poller_started = False
 
     def _refresh(self, force: bool = False) -> None:
         c = self._cache
@@ -157,9 +248,11 @@ class DeploymentHandle:
                 c.deployments = routing["deployments"]
         self._ensure_poller()
 
-    def _pick_replica(self, args: tuple = (), kwargs: Optional[dict] = None):
+    def _pick_replica(self, args: tuple = (), kwargs: Optional[dict] = None,
+                      wait_deadline: Optional[float] = None):
         c = self._cache
-        deadline = time.monotonic() + 30
+        deadline = (time.monotonic() + 30 if wait_deadline is None
+                    else wait_deadline)
         while True:
             self._refresh()
             info = c.deployments.get(self.deployment_name)
@@ -167,8 +260,8 @@ class DeploymentHandle:
             if replicas:
                 break
             if time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"no replicas for deployment "
+                raise NoHealthyReplicasError(
+                    f"no healthy replicas for deployment "
                     f"{self.deployment_name!r}")
             time.sleep(0.1)
             self._refresh(force=True)
@@ -214,23 +307,120 @@ class DeploymentHandle:
             if n > 0:
                 c.outstanding[replica_id] = n - 1
 
+    def _deployment_info(self) -> Dict[str, Any]:
+        return self._cache.deployments.get(self.deployment_name) or {}
+
+    def _request_timeout_s(self) -> float:
+        return float(self._deployment_info().get("request_timeout_s", 60.0))
+
     # -- invocation ------------------------------------------------------
-    def remote(self, *args, **kwargs):
-        rid, actor = self._pick_replica(args, kwargs)
+    def _invoke_once(self, args: tuple, kwargs: dict,
+                     wait_deadline: Optional[float] = None):
+        """One pick+submit attempt; outstanding[rid] is incremented and the
+        caller owns decrementing it when the call completes."""
+        rid, actor = self._pick_replica(args, kwargs, wait_deadline)
         ctx = ({"multiplexed_model_id": self._multiplexed_model_id}
                if self._multiplexed_model_id else None)
         try:
             if self._stream:
-                gen = actor.handle_request.options(
+                out = actor.handle_request.options(
                     num_returns="dynamic").remote(
                         self._method_name, args, kwargs, ctx)
-                return DeploymentResponseGenerator(gen, self, rid)
-            ref = actor.handle_request_unary.remote(
-                self._method_name, args, kwargs, ctx)
-            return DeploymentResponse(ref, self, rid)
+            else:
+                out = actor.handle_request_unary.remote(
+                    self._method_name, args, kwargs, ctx)
+            return rid, out
         except Exception:
             self._dec(rid)
             raise
+
+    def remote(self, *args, **kwargs):
+        rid, out = self._invoke_once(args, kwargs)
+        if self._stream:
+            return DeploymentResponseGenerator(out, self, rid, args, kwargs)
+        return DeploymentResponse(out, self, rid, args, kwargs)
+
+    # -- backpressure retry (the handle's bounded pending queue) ---------
+    def _enter_queue(self, first_exc: Exception) -> None:
+        c = self._cache
+        max_queued = int(self._deployment_info().get(
+            "max_queued_requests", 64))
+        with c.lock:
+            if c.queued >= max_queued:
+                raise BackPressureError(
+                    f"pending queue full for deployment "
+                    f"{self.deployment_name!r} "
+                    f"(max_queued_requests={max_queued})") from first_exc
+            c.queued += 1
+
+    def _leave_queue(self) -> None:
+        c = self._cache
+        with c.lock:
+            if c.queued > 0:
+                c.queued -= 1
+
+    def queued_requests(self) -> int:
+        with self._cache.lock:
+            return self._cache.queued
+
+    def _retry_shed(self, args: tuple, kwargs: dict,
+                    deadline: Optional[float], first_exc: Exception):
+        """Blocking retry after a replica shed the request: hold one
+        bounded-queue slot, sleep with jittered exponential backoff, and
+        re-submit via a fresh pow-2 pick (the load that caused the shed
+        steers the pick away). Raises BackPressureError once the queue is
+        full or the deadline passes — never waits unboundedly."""
+        from ray_tpu._private.backoff import delay_for_attempt
+
+        if deadline is None:
+            deadline = time.monotonic() + self._request_timeout_s()
+        self._enter_queue(first_exc)
+        try:
+            attempt = 0
+            while True:
+                d = delay_for_attempt(attempt, initial=0.02, maximum=0.5)
+                attempt += 1
+                if time.monotonic() + d >= deadline:
+                    raise BackPressureError(
+                        f"request to {self.deployment_name!r} still shed "
+                        f"at deadline after {attempt} attempts"
+                    ) from first_exc
+                time.sleep(d)
+                rid, ref = self._invoke_once(args, kwargs,
+                                             wait_deadline=deadline)
+                try:
+                    out = ray_tpu.get(
+                        ref, timeout=max(
+                            0.0, deadline - time.monotonic()))
+                except Exception as e:
+                    self._dec(rid)
+                    if unwrap_backpressure(e) is None:
+                        raise
+                    continue  # shed again: next backoff round
+                self._dec(rid)
+                return out, ref, rid
+        finally:
+            self._leave_queue()
+
+    def _retry_shed_stream(self, args: tuple, kwargs: dict,
+                           deadline: float, attempt: int,
+                           first_exc: Exception):
+        """Streaming flavor: one backoff round per call (the iterator owns
+        the attempt counter and deadline), returning a fresh generator with
+        outstanding[rid] held by the caller."""
+        from ray_tpu._private.backoff import delay_for_attempt
+
+        d = delay_for_attempt(attempt, initial=0.02, maximum=0.5)
+        if time.monotonic() + d >= deadline:
+            raise BackPressureError(
+                f"stream request to {self.deployment_name!r} still shed "
+                f"at deadline") from first_exc
+        self._enter_queue(first_exc)
+        try:
+            time.sleep(d)
+        finally:
+            self._leave_queue()
+        return self._invoke_once(args, kwargs, wait_deadline=deadline)
 
     def __reduce__(self):
         return (DeploymentHandle,

@@ -2,28 +2,83 @@
 
 Redesign: a stdlib asyncio HTTP/1.1 server inside an async actor — no
 uvicorn/starlette dependency. JSON in/out; streaming handles produce
-chunked-transfer responses (one chunk per generator item)."""
+chunked-transfer responses (one chunk per generator item).
+
+Overload contract (reference: SEDA adaptive admission control, DAGOR):
+every queueing stage sheds explicitly instead of collapsing —
+* admission ceiling: more than ``max_concurrent_requests`` in flight →
+  429 + Retry-After without touching the handle plane;
+* replica/handle backpressure (``BackPressureError``) → 429 + Retry-After;
+* per-deployment ``request_timeout_s`` expiry → 504;
+* dead actor / no healthy replica → 503 + Retry-After;
+* oversized body → 413, oversized header block → 431 (connection closed);
+every shed increments ``ray_tpu_serve_shed_total{deployment,reason}``.
+Liveness (``/-/healthz``) and readiness (``/-/ready``: the route table has
+been fetched from the controller at least once, and not draining) are
+split so a load balancer never sends traffic to a blind proxy. Shutdown
+is drain-aware: ``drain()`` closes the listener first, then waits out
+in-flight requests before the controller kills the actor."""
 
 from __future__ import annotations
 
 import asyncio
 import json
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Dict, Optional, Tuple
 
 import ray_tpu
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    NoHealthyReplicasError,
+    RayActorError,
+    unwrap_backpressure,
+)
 from ray_tpu.serve._common import CONTROLLER_NAME
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+# Request-line / header-block parsing bounds (431 beyond them): a
+# misbehaving client must not be able to balloon proxy memory with an
+# unbounded header flood before admission control ever sees the request.
+MAX_HEADER_COUNT = 128
+MAX_HEADER_BYTES = 64 * 1024
+# Declared-body ceiling (413 beyond it) — checked against content-length
+# BEFORE the body is read, so the bytes are never buffered.
+MAX_BODY_BYTES = 8 * 1024 * 1024
+# Proxy-wide concurrent-request ceiling (429 beyond it).
+MAX_CONCURRENT_REQUESTS = 256
+# Fallback when a route has no deployment config behind it yet.
+DEFAULT_REQUEST_TIMEOUT_S = 60.0
+
+_RETRY_AFTER = b"retry-after: 1\r\n"
+
 
 class ProxyActor:
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0,
+                 max_concurrent_requests: int = MAX_CONCURRENT_REQUESTS,
+                 max_body_bytes: int = MAX_BODY_BYTES,
+                 max_header_bytes: int = MAX_HEADER_BYTES,
+                 request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S):
         self._port = port
         self._server: Optional[asyncio.AbstractServer] = None
         self._routes: Dict[str, str] = {}  # prefix -> deployment name
+        self._deployments: Dict[str, Any] = {}  # name -> routing info
         self._handles: Dict[str, Any] = {}
         self._version = -1
+        self._max_concurrent = int(max_concurrent_requests)
+        self._max_body = int(max_body_bytes)
+        self._max_header_bytes = int(max_header_bytes)
+        self._default_timeout_s = float(request_timeout_s)
+        self._ongoing = 0
+        self._ready = False
+        self._draining = False
+        from ray_tpu.util import metrics as um
+
+        self._m_shed = um.get_counter(
+            "ray_tpu_serve_shed_total",
+            "Serve requests shed by overload control, by stage/reason",
+            tag_keys=("deployment", "reason"))
 
     async def start(self) -> int:
         self._server = await asyncio.start_server(
@@ -36,9 +91,24 @@ class ProxyActor:
     def port(self) -> int:
         return self._port
 
-    async def _route_refresh_loop(self) -> None:
-        from ray_tpu.serve._handle import DeploymentHandle
+    async def drain(self, timeout_s: float = 10.0) -> int:
+        """Drain-aware shutdown (reference: proxy drain before controller
+        kill): close the listener FIRST so no new connection lands, mark
+        unready (load balancers stop sending), then wait out in-flight
+        requests. Returns how many were still in flight at the end."""
+        self._draining = True
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except Exception:
+                pass
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while self._ongoing > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+        return self._ongoing
 
+    async def _route_refresh_loop(self) -> None:
         loop = asyncio.get_running_loop()
         # get_actor is a blocking driver-style call — it must run on an
         # executor thread, never on this event loop (it would deadlock the
@@ -65,6 +135,7 @@ class ProxyActor:
         if routing is None:
             return
         self._version = routing["version"]
+        self._deployments = routing["deployments"]
         routes = {}
         for name, info in routing["deployments"].items():
             prefix = info.get("route_prefix")
@@ -73,6 +144,9 @@ class ProxyActor:
                 if name not in self._handles:
                     self._handles[name] = DeploymentHandle(name)
         self._routes = routes
+        # Readiness = the route table has loaded at least once, even if it
+        # is empty: the proxy is no longer blind to the controller.
+        self._ready = True
 
     async def _force_refresh(self) -> None:
         controller = getattr(self, "_controller", None)
@@ -96,21 +170,52 @@ class ProxyActor:
                 except ValueError:
                     return
                 headers: Dict[str, str] = {}
+                header_bytes = len(line)
+                overflow = False
                 while True:
                     h = await reader.readline()
                     if h in (b"\r\n", b"", b"\n"):
                         break
+                    header_bytes += len(h)
+                    if (len(headers) >= MAX_HEADER_COUNT
+                            or header_bytes > self._max_header_bytes):
+                        # Keep consuming to the blank line so the 431 can
+                        # go out on a valid HTTP exchange, but parse no
+                        # more — bounded by the stream's own readline cap.
+                        overflow = True
+                        continue
                     k, _, v = h.decode().partition(":")
                     headers[k.strip().lower()] = v.strip()
+                if overflow:
+                    self._shed("-", "headers_too_large")
+                    await self._respond(writer, 431,
+                                        b"header block too large",
+                                        close=True)
+                    return
+                try:
+                    n = int(headers.get("content-length", 0) or 0)
+                except ValueError:
+                    await self._respond(writer, 400,
+                                        b"bad content-length", close=True)
+                    return
+                if n < 0 or n > self._max_body:
+                    # Reject on the DECLARED size — the body is never read,
+                    # so the connection cannot be reused: close it.
+                    self._shed("-", "body_too_large")
+                    await self._respond(writer, 413,
+                                        b"body too large", close=True)
+                    return
                 body = b""
-                n = int(headers.get("content-length", 0) or 0)
                 if n:
                     body = await reader.readexactly(n)
                 keep = await self._dispatch(method, path, headers, body,
                                             writer)
                 if not keep:
                     return
-        except (ConnectionError, asyncio.IncompleteReadError):
+        except (ConnectionError, asyncio.IncompleteReadError,
+                asyncio.LimitOverrunError, ValueError):
+            # ValueError/LimitOverrunError: a single line (request line or
+            # header) blew past the StreamReader's 64 KiB limit.
             pass
         finally:
             try:
@@ -127,11 +232,39 @@ class ProxyActor:
                     best = (prefix, name)
         return best
 
+    def _shed(self, deployment: str, reason: str) -> None:
+        self._m_shed.inc(tags={"deployment": deployment, "reason": reason})
+
+    def _timeout_for(self, name: str) -> float:
+        info = self._deployments.get(name) or {}
+        try:
+            return float(info.get("request_timeout_s",
+                                  self._default_timeout_s))
+        except (TypeError, ValueError):
+            return self._default_timeout_s
+
     async def _dispatch(self, method: str, path: str, headers: Dict[str, str],
                         body: bytes, writer: asyncio.StreamWriter) -> bool:
         if path == "/-/healthz":
+            # Liveness: the process is up and serving its event loop.
             await self._respond(writer, 200, b"ok")
             return True
+        if path == "/-/ready":
+            # Readiness: routes fetched from the controller and not
+            # draining — the gate a load balancer should use.
+            if self._ready and not self._draining:
+                await self._respond(writer, 200, b"ready")
+            else:
+                await self._respond(writer, 503,
+                                    b"draining" if self._draining
+                                    else b"route table not loaded",
+                                    extra=_RETRY_AFTER)
+            return True
+        if self._draining:
+            self._shed("-", "draining")
+            await self._respond(writer, 503, b"proxy draining",
+                                extra=_RETRY_AFTER, close=True)
+            return False
         match = self._match(path)
         if match is None:
             # The periodic refresh may lag a just-deployed app — check the
@@ -142,7 +275,38 @@ class ProxyActor:
             await self._respond(writer, 404, b"no route")
             return True
         prefix, name = match
+        # Admission ceiling: shed at the door instead of queueing
+        # unboundedly in the handle plane (SEDA: goodput collapses exactly
+        # at peak when every stage accepts blindly).
+        if self._ongoing >= self._max_concurrent:
+            self._shed(name, "proxy_capacity")
+            await self._respond(writer, 429, b"proxy at capacity",
+                                extra=_RETRY_AFTER)
+            return True
+        # Fail fast when the deployment is known to have zero healthy
+        # replicas — no point burning the request timeout to learn it.
+        info = self._deployments.get(name)
+        if info is not None and not info.get("replicas"):
+            await self._force_refresh()
+            info = self._deployments.get(name)
+            if info is not None and not info.get("replicas"):
+                self._shed(name, "no_replica")
+                await self._respond(writer, 503, b"no healthy replicas",
+                                    extra=_RETRY_AFTER)
+                return True
+        self._ongoing += 1
+        try:
+            return await self._dispatch_inner(
+                method, path, headers, body, writer, prefix, name)
+        finally:
+            self._ongoing -= 1
+
+    async def _dispatch_inner(self, method: str, path: str,
+                              headers: Dict[str, str], body: bytes,
+                              writer: asyncio.StreamWriter,
+                              prefix: str, name: str) -> bool:
         handle = self._handles[name]
+        timeout_s = self._timeout_for(name)
         payload: Any = None
         if body:
             try:
@@ -177,8 +341,10 @@ class ProxyActor:
 
                 # Peek the first item: a {"__http__": {...}} envelope lets
                 # the deployment pick the response content-type (SSE for
-                # OpenAI-compatible endpoints).
-                first = await loop.run_in_executor(None, _next)
+                # OpenAI-compatible endpoints). The peek also absorbs any
+                # backpressure retry BEFORE the 200 status line commits.
+                first = await asyncio.wait_for(
+                    loop.run_in_executor(None, _next), timeout_s)
                 ctype = b"application/json"
                 if isinstance(first, dict) and "__http__" in first:
                     ctype = str(first["__http__"].get(
@@ -204,8 +370,15 @@ class ProxyActor:
                 writer.write(b"0\r\n\r\n")
                 await writer.drain()
                 return True
-            resp = await loop.run_in_executor(
-                None, lambda: handle.remote(request).result(timeout=120))
+            # The wait_for is the hard hang-proofing bound: even if the
+            # executor call wedges below result()'s own timeout (e.g. a
+            # stuck replica pick), the client still gets its 504.
+            resp = await asyncio.wait_for(
+                loop.run_in_executor(
+                    None,
+                    lambda: handle.remote(request).result(
+                        timeout=timeout_s)),
+                timeout_s + 5.0)
             status = 200
             ctype = b"application/json"
             if isinstance(resp, dict) and "__http__" in resp:
@@ -218,14 +391,39 @@ class ProxyActor:
             await self._respond(writer, status, data, ctype=ctype)
             return True
         except Exception as e:
+            status, reason, note = _classify_error(e)
+            if reason is not None:
+                self._shed(name, reason)
+                await self._respond(
+                    writer, status, note,
+                    extra=_RETRY_AFTER if status in (429, 503) else b"")
+                return True
             logger.exception("request failed")
             await self._respond(writer, 500, str(e).encode())
             return True
 
     async def _respond(self, writer, status: int, body: bytes,
-                       ctype: bytes = b"text/plain") -> None:
+                       ctype: bytes = b"text/plain", extra: bytes = b"",
+                       close: bool = False) -> None:
+        conn = b"close" if close else b"keep-alive"
         writer.write(b"HTTP/1.1 " + str(status).encode() +
                      b" X\r\ncontent-type: " + ctype +
                      b"\r\ncontent-length: " + str(len(body)).encode() +
-                     b"\r\nconnection: keep-alive\r\n\r\n" + body)
+                     b"\r\n" + extra +
+                     b"connection: " + conn + b"\r\n\r\n" + body)
         await writer.drain()
+
+
+def _classify_error(e: BaseException) -> Tuple[int, Optional[str], bytes]:
+    """Map a dispatch failure to (status, shed_reason, body). shed_reason
+    None = not an overload shed: log + 500 like any other bug."""
+    if unwrap_backpressure(e) is not None:
+        return 429, "backpressure", b"overloaded, retry later"
+    if isinstance(e, (GetTimeoutError, asyncio.TimeoutError, TimeoutError)):
+        return 504, "timeout", b"request timed out"
+    if isinstance(e, NoHealthyReplicasError):
+        return 503, "no_replica", b"no healthy replicas"
+    if isinstance(e, RayActorError) or isinstance(
+            getattr(e, "cause", None), RayActorError):
+        return 503, "replica_died", b"replica unavailable"
+    return 500, None, b""

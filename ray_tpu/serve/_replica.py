@@ -14,7 +14,8 @@ from typing import Any, Dict, Optional, Tuple
 class ReplicaActor:
     def __init__(self, serialized_ctor, init_args: Tuple, init_kwargs: Dict,
                  user_config: Optional[Dict[str, Any]] = None,
-                 deployment_name: str = ""):
+                 deployment_name: str = "",
+                 max_ongoing_requests: int = 0):
         import cloudpickle
 
         ctor = cloudpickle.loads(serialized_ctor)
@@ -32,6 +33,14 @@ class ReplicaActor:
 
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
+        # Hard admission cap (reference: replica_scheduler queue_len-based
+        # acceptance): 0 = unbounded (legacy direct-actor use); over-cap
+        # requests are SHED with BackPressureError instead of silently
+        # queueing in the actor mailbox past max_ongoing_requests.
+        self._max_ongoing = max(0, int(max_ongoing_requests))
+        # Draining: set by prepare_for_shutdown before the controller kills
+        # this replica; new requests shed, in-flight ones run to completion.
+        self._draining = False
         # Serve request metrics (reference: serve/_private/metrics —
         # the names the shipped Grafana serve dashboard charts). Counted
         # here, at the replica, so handle calls and HTTP both register.
@@ -51,6 +60,10 @@ class ReplicaActor:
             "Requests currently executing in this replica "
             "(the autoscaling signal)",
             tag_keys=("deployment", "replica"))
+        self._m_shed = um.get_counter(
+            "ray_tpu_serve_shed_total",
+            "Serve requests shed by overload control, by stage/reason",
+            tag_keys=("deployment", "reason"))
 
     def _resolve_method(self, method_name: str):
         if callable(self._callable) and method_name == "__call__":
@@ -115,6 +128,23 @@ class ReplicaActor:
             # gauge publication must be atomic, or two racing finishes can
             # publish out of order and pin a stale nonzero value.
             with self._ongoing_lock:
+                # Admission check is atomic with the increment — two
+                # racing over-cap requests must not both slip under it.
+                if self._draining:
+                    self._m_shed.inc(tags={"deployment": dep,
+                                           "reason": "replica_draining"})
+                    from ray_tpu.exceptions import BackPressureError
+
+                    raise BackPressureError(
+                        f"replica of {dep!r} is draining for shutdown")
+                if self._max_ongoing and self._ongoing >= self._max_ongoing:
+                    self._m_shed.inc(tags={"deployment": dep,
+                                           "reason": "replica_capacity"})
+                    from ray_tpu.exceptions import BackPressureError
+
+                    raise BackPressureError(
+                        f"replica of {dep!r} at max_ongoing_requests="
+                        f"{self._max_ongoing}")
                 self._ongoing += 1
                 self._m_ongoing.set(self._ongoing, tags=gauge_tags)
             ok = True
@@ -136,6 +166,26 @@ class ReplicaActor:
         return cm()
 
     def num_ongoing_requests(self) -> int:
+        with self._ongoing_lock:
+            return self._ongoing
+
+    def prepare_for_shutdown(self, timeout_s: float = 10.0) -> int:
+        """Graceful drain (reference: replica.py perform_graceful_shutdown):
+        stop admitting — new requests shed with BackPressureError so the
+        handle re-routes them — then wait for in-flight requests to finish,
+        up to ``timeout_s``. Returns the number still in flight at the end
+        (0 = fully drained); the controller kills the actor either way.
+        Runs on an executor thread, so in-flight request threads proceed."""
+        import time
+
+        with self._ongoing_lock:
+            self._draining = True
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        while time.monotonic() < deadline:
+            with self._ongoing_lock:
+                if self._ongoing == 0:
+                    return 0
+            time.sleep(0.02)
         with self._ongoing_lock:
             return self._ongoing
 

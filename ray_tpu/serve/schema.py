@@ -36,6 +36,9 @@ class DeploymentOverride:
     name: str
     num_replicas: Optional[int] = None
     max_ongoing_requests: Optional[int] = None
+    max_queued_requests: Optional[int] = None
+    request_timeout_s: Optional[float] = None
+    graceful_shutdown_timeout_s: Optional[float] = None
     user_config: Optional[Dict[str, Any]] = None
     ray_actor_options: Optional[Dict[str, Any]] = None
 
@@ -107,6 +110,13 @@ class ApplicationSchema:
                     dep.num_replicas = ov.num_replicas
                 if ov.max_ongoing_requests is not None:
                     dep.max_ongoing_requests = ov.max_ongoing_requests
+                if ov.max_queued_requests is not None:
+                    dep.max_queued_requests = ov.max_queued_requests
+                if ov.request_timeout_s is not None:
+                    dep.request_timeout_s = ov.request_timeout_s
+                if ov.graceful_shutdown_timeout_s is not None:
+                    dep.graceful_shutdown_timeout_s = \
+                        ov.graceful_shutdown_timeout_s
                 if ov.user_config is not None:
                     dep.user_config = ov.user_config
                 if ov.ray_actor_options is not None:

@@ -288,6 +288,59 @@ def test_task_event_buffer_bounded(ray_start_regular, monkeypatch):
     assert counter._values.get((), 0.0) > before  # drops are counted
 
 
+def test_serve_shed_metric_emitted(ray_start_regular):
+    """Overload sheds are COUNTED: a replica-capacity shed shows up in
+    the cross-process merged ray_tpu_serve_shed_total with its
+    deployment + reason tags (ISSUE 8: every shed stage is observable)."""
+    import threading
+
+    from ray_tpu import serve
+    from ray_tpu.exceptions import BackPressureError
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=0,
+                      graceful_shutdown_timeout_s=3.0)
+    class Busy:
+        def __call__(self, request):
+            time.sleep(1.5)
+            return "ok"
+
+    try:
+        handle = serve.run(Busy.bind())
+        occ = []
+        t = threading.Thread(
+            target=lambda: occ.append(
+                handle.remote({}).result(timeout=60)))
+        t.start()
+        time.sleep(0.4)
+        shed = 0
+        for _ in range(3):
+            try:
+                handle.remote({}).result(timeout=10)
+            except BackPressureError:
+                shed += 1
+        assert shed, "replica never shed while saturated"
+        t.join(timeout=60)
+        assert occ == ["ok"]
+        # The replica flushes its registry to the GCS KV every ~2s; the
+        # merged view must converge on the shed count.
+        deadline = time.time() + 30
+        counted = 0.0
+        while time.time() < deadline:
+            m = um.query_metrics().get("ray_tpu_serve_shed_total")
+            if m:
+                counted = sum(
+                    v for tags, v in m["values"].items()
+                    if dict(tags).get("deployment") == "Busy"
+                    and dict(tags).get("reason") == "replica_capacity")
+                if counted >= shed:
+                    break
+            time.sleep(1.0)
+        assert counted >= shed, (counted, shed)
+    finally:
+        serve.shutdown()
+
+
 # Runs LAST in this module: it clears the driver process's live metric
 # values (the earlier live-contract test needs them intact).
 def test_fork_reset_rekeys_and_clears_values():

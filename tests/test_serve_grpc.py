@@ -85,3 +85,26 @@ def test_grpc_streaming(serve_instance):
                               payload=json.dumps({"n": 4}).encode(),
                               content_type="application/json"))]
     assert items == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
+
+
+def test_grpc_stub_contract_checker(tmp_path, monkeypatch):
+    """The stub-drift lint passes against the real tree, and catches an
+    rpc added to the .proto that never reached the hand-written stubs."""
+    from ray_tpu.scripts import check_grpc_stubs as cgs
+
+    assert cgs.main() == 0
+
+    proto = open(cgs.PROTO_PATH).read()
+    tampered = tmp_path / "serve_grpc.proto"
+    tampered.write_text(proto.replace(
+        "rpc Healthz(HealthzRequest) returns (HealthzReply);",
+        "rpc Healthz(HealthzRequest) returns (HealthzReply);\n"
+        "  rpc Evict(PredictRequest) returns (PredictReply);"))
+    monkeypatch.setattr(cgs, "PROTO_PATH", str(tampered))
+    assert cgs.main() == 1
+
+    # A streaming-shape mismatch is also drift, not just a missing rpc.
+    tampered.write_text(proto.replace(
+        "rpc PredictStream(PredictRequest) returns (stream PredictReply);",
+        "rpc PredictStream(PredictRequest) returns (PredictReply);"))
+    assert cgs.main() == 1

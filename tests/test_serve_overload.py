@@ -1,0 +1,560 @@
+"""Serve overload robustness: end-to-end admission control, load
+shedding, and graceful draining (reference: SEDA adaptive admission /
+DAGOR overload control; serve's max_ongoing_requests +
+max_queued_requests + request_timeout_s knobs).
+
+Covers the full shed contract across all three tiers:
+* replica: hard max_ongoing_requests cap -> BackPressureError;
+* handle: bounded pending queue with jittered pow-2 retry, shed once
+  the queue is full or the deadline passes;
+* proxy: 429+Retry-After / 504 / 503 / 413 / 431 status mapping,
+  liveness-vs-readiness split, drain-aware shutdown;
+plus a slow-marked chaos soak at ~2x capacity proving every request
+terminates and the shed metric matches what clients observed."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.exceptions import BackPressureError
+
+
+@pytest.fixture
+def serve_instance(ray_start_regular):
+    yield
+    serve.shutdown()
+
+
+def _lower(headers) -> dict:
+    return {k.lower(): v for k, v in dict(headers).items()}
+
+
+def _post(port, path, payload, timeout=60):
+    """Return (status, lowercase headers, body); HTTP error statuses are
+    returned, not raised."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"content-type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, _lower(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        headers = _lower(e.headers)
+        e.close()
+        return e.code, headers, body
+
+
+def _get(port, path, timeout=30):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status, _lower(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        headers = _lower(e.headers)
+        e.close()
+        return e.code, headers, body
+
+
+def _raw_exchange(port, data, timeout=15):
+    """Send raw bytes, read until the server closes the connection."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        s.sendall(data)
+        chunks = []
+        while True:
+            b = s.recv(4096)
+            if not b:
+                break
+            chunks.append(b)
+        return b"".join(chunks)
+    finally:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# Tier 1+2: replica hard cap and the handle's bounded retry queue.
+# ---------------------------------------------------------------------------
+def test_replica_cap_sheds_backpressure_when_queue_disabled(serve_instance):
+    """max_ongoing_requests is a HARD cap: with the handle queue disabled
+    the shed surfaces to the caller as BackPressureError, fast — it must
+    not park in the actor mailbox until the running request finishes."""
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=0,
+                      graceful_shutdown_timeout_s=3.0)
+    class Slow:
+        def __call__(self, request):
+            time.sleep(1.2)
+            return "done"
+
+    handle = serve.run(Slow.bind())
+    occupier_out = []
+    t = threading.Thread(
+        target=lambda: occupier_out.append(
+            handle.remote({}).result(timeout=60)))
+    t.start()
+    time.sleep(0.4)  # occupier is executing inside the replica
+    t0 = time.monotonic()
+    with pytest.raises(BackPressureError):
+        handle.remote({}).result(timeout=30)
+    shed_latency = time.monotonic() - t0
+    # The shed is immediate (queue disabled), not serialized behind the
+    # 1.2s occupier.
+    assert shed_latency < 1.0, shed_latency
+    t.join(timeout=60)
+    assert occupier_out == ["done"]
+
+
+def test_handle_queue_retries_shed_requests_to_success(serve_instance):
+    """With queue headroom, shed requests wait in the handle's bounded
+    queue and retry with backoff until a slot frees — all complete."""
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=8, request_timeout_s=30,
+                      graceful_shutdown_timeout_s=3.0)
+    class Quick:
+        def __call__(self, request):
+            time.sleep(0.2)
+            return "ok"
+
+    handle = serve.run(Quick.bind())
+    results, errors = [], []
+
+    def worker():
+        try:
+            results.append(handle.remote({}).result(timeout=30))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(5)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert results == ["ok"] * 5
+
+
+def test_handle_queue_full_sheds_excess(serve_instance):
+    """Once the pending queue fills, further requests shed immediately
+    with BackPressureError instead of queueing unboundedly."""
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=1, request_timeout_s=20,
+                      graceful_shutdown_timeout_s=3.0)
+    class Slow:
+        def __call__(self, request):
+            time.sleep(1.0)
+            return "ok"
+
+    handle = serve.run(Slow.bind())
+    results, errors = [], []
+
+    def worker():
+        try:
+            results.append(handle.remote({}).result(timeout=30))
+        except BackPressureError as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    assert len(results) + len(errors) == 6
+    assert len(results) >= 2, (results, errors)  # runner + queued complete
+    assert len(errors) >= 1, results  # queue of 1 cannot hold 5 waiters
+
+
+def test_streaming_shed_retries_before_first_item(serve_instance):
+    """A stream shed before its first item re-picks a replica through the
+    same bounded-queue path; both streams deliver every item."""
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=4, request_timeout_s=30,
+                      graceful_shutdown_timeout_s=3.0)
+    class Streamer:
+        def gen(self, n):
+            for i in range(n):
+                time.sleep(0.15)
+                yield i
+
+    handle = serve.run(Streamer.bind())
+    sh = handle.options(method_name="gen", stream=True)
+    out1, out2, errors = [], [], []
+
+    def consume(sink):
+        try:
+            for item in sh.remote(4):
+                sink.append(item)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t1 = threading.Thread(target=consume, args=(out1,))
+    t1.start()
+    time.sleep(0.2)  # first stream holds the only slot
+    t2 = threading.Thread(target=consume, args=(out2,))
+    t2.start()
+    t1.join(timeout=60)
+    t2.join(timeout=60)
+    assert not errors, errors
+    assert out1 == [0, 1, 2, 3]
+    assert out2 == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# Tier 3: HTTP proxy status-code contract.
+# ---------------------------------------------------------------------------
+def test_http_429_retry_after_and_504_timeout(serve_instance):
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=0, request_timeout_s=2.0,
+                      graceful_shutdown_timeout_s=1.0)
+    def napper(request):
+        time.sleep(float(request["body"]["sleep"]))
+        return {"ok": True}
+
+    serve.run(napper.bind(), route_prefix="/nap")
+    port = serve.http_port()
+
+    # Saturate the single slot, then expect a fast 429 with Retry-After.
+    occ = []
+    t = threading.Thread(
+        target=lambda: occ.append(_post(port, "/nap", {"sleep": 1.2})))
+    t.start()
+    time.sleep(0.4)
+    status, headers, body = _post(port, "/nap", {"sleep": 0}, timeout=30)
+    assert status == 429, (status, body)
+    assert headers.get("retry-after") == "1", headers
+    t.join(timeout=60)
+    assert occ and occ[0][0] == 200
+
+    # A request outliving request_timeout_s gets a 504, not a hang.
+    t0 = time.monotonic()
+    status, _, body = _post(port, "/nap", {"sleep": 6}, timeout=30)
+    assert status == 504, (status, body)
+    assert time.monotonic() - t0 < 10.0
+    time.sleep(4.5)  # let the stranded sleeper finish before teardown
+
+
+def test_http_413_431_and_400_reject_before_dispatch(serve_instance):
+    @serve.deployment
+    def echo(request):
+        return {"ok": True}
+
+    serve.run(echo.bind(), route_prefix="/echo")
+    port = serve.http_port()
+
+    # Declared body over the cap: 413 without ever reading the body.
+    resp = _raw_exchange(
+        port,
+        b"POST /echo HTTP/1.1\r\nhost: x\r\n"
+        b"content-length: 999999999\r\n\r\n")
+    assert resp.startswith(b"HTTP/1.1 413"), resp[:80]
+    assert b"connection: close" in resp
+
+    # Header flood: 431 and the connection closes.
+    flood = b"".join(b"x-h%d: 1\r\n" % i for i in range(200))
+    resp = _raw_exchange(
+        port, b"GET /echo HTTP/1.1\r\nhost: x\r\n" + flood + b"\r\n")
+    assert resp.startswith(b"HTTP/1.1 431"), resp[:80]
+    assert b"connection: close" in resp
+
+    # Unparseable content-length: 400.
+    resp = _raw_exchange(
+        port,
+        b"POST /echo HTTP/1.1\r\nhost: x\r\ncontent-length: abc\r\n\r\n")
+    assert resp.startswith(b"HTTP/1.1 400"), resp[:80]
+
+    # The proxy is still healthy for well-formed requests afterward.
+    status, _, body = _post(port, "/echo", {"x": 1})
+    assert status == 200 and json.loads(body) == {"ok": True}
+
+
+def test_healthz_liveness_vs_ready_readiness(serve_instance):
+    """/-/healthz is pure liveness; /-/ready gates on the route table
+    having loaded from the controller — a blind proxy must not be sent
+    traffic by a load balancer."""
+    from ray_tpu.serve._proxy import ProxyActor
+
+    Proxy = ray_tpu.remote(ProxyActor)
+    bare = Proxy.options(max_concurrency=16, num_cpus=0.1).remote(0)
+    port = ray_tpu.get(bare.start.remote(), timeout=60)
+    try:
+        status, _, body = _get(port, "/-/healthz")
+        assert (status, body) == (200, b"ok")
+        # No controller exists yet: alive but NOT ready.
+        status, headers, _ = _get(port, "/-/ready")
+        assert status == 503
+        assert headers.get("retry-after") == "1"
+
+        # Once a controller appears and the table loads, readiness flips.
+        @serve.deployment
+        def tiny(request):
+            return "hi"
+
+        serve.run(tiny.bind())
+        deadline = time.time() + 30
+        status = None
+        while time.time() < deadline:
+            status, _, _ = _get(port, "/-/ready")
+            if status == 200:
+                break
+            time.sleep(0.5)
+        assert status == 200, "bare proxy never became ready"
+        # Liveness is unaffected throughout.
+        assert _get(port, "/-/healthz")[0] == 200
+    finally:
+        ray_tpu.kill(bare)
+
+
+def test_http_503_when_all_replicas_unhealthy(serve_instance, tmp_path):
+    """Zero healthy replicas fail fast with 503 + Retry-After instead of
+    burning the full request timeout."""
+    flag = str(tmp_path / "sick")
+
+    @serve.deployment(num_replicas=1, graceful_shutdown_timeout_s=1.0)
+    class Flaky:
+        def __init__(self, flag_path):
+            self.flag_path = flag_path
+
+        def __call__(self, request):
+            return {"ok": True}
+
+        def check_health(self):
+            if os.path.exists(self.flag_path):
+                raise RuntimeError("induced sickness")
+
+    serve.run(Flaky.bind(flag), route_prefix="/flaky")
+    port = serve.http_port()
+    assert _post(port, "/flaky", {})[0] == 200
+
+    with open(flag, "w") as f:
+        f.write("x")
+    deadline = time.time() + 45
+    saw = None
+    while time.time() < deadline:
+        status, headers, _ = _post(port, "/flaky", {}, timeout=30)
+        if status == 503:
+            saw = (status, headers.get("retry-after"))
+            break
+        time.sleep(0.5)
+    assert saw == (503, "1"), \
+        f"503 with Retry-After never surfaced: {saw}"
+
+
+# ---------------------------------------------------------------------------
+# Graceful draining.
+# ---------------------------------------------------------------------------
+def test_graceful_drain_zero_errors_on_downscale(serve_instance):
+    """Downscaling drains the victim: its in-flight requests finish, new
+    ones re-route to survivors — callers observe ZERO failures."""
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=8,
+                      max_queued_requests=32, request_timeout_s=30,
+                      graceful_shutdown_timeout_s=15.0)
+    class Napper:
+        def __call__(self, request):
+            time.sleep(1.0)
+            return os.getpid()
+
+    handle = serve.run(Napper.bind())
+    results, errors = [], []
+
+    def worker():
+        try:
+            results.append(handle.remote({}).result(timeout=60))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(12)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)  # requests in flight on BOTH replicas
+    # Redeploy at half size: the controller drains one replica while its
+    # requests are still executing.
+    serve.run(Napper.options(num_replicas=1).bind())
+    for t in threads:
+        t.join(timeout=90)
+    assert not any(t.is_alive() for t in threads), "request hung"
+    assert not errors, errors
+    assert len(results) == 12
+    # Both replicas served traffic before the drain — the drained one's
+    # in-flight work completed rather than being cut off.
+    assert len(set(results)) == 2, set(results)
+    status = serve.status()
+    assert status["Napper"]["target"] == 1
+
+
+def test_proxy_drain_rejects_new_accepts_inflight(serve_instance):
+    """serve.shutdown() drains the proxy: listener closes first so no new
+    connection lands, while accepted requests run to completion."""
+
+    @serve.deployment(max_ongoing_requests=8,
+                      graceful_shutdown_timeout_s=5.0)
+    def slowish(request):
+        time.sleep(1.0)
+        return {"ok": True}
+
+    serve.run(slowish.bind(), route_prefix="/slowish")
+    port = serve.http_port()
+    out = []
+    t = threading.Thread(
+        target=lambda: out.append(_post(port, "/slowish", {}, timeout=30)))
+    t.start()
+    time.sleep(0.3)
+    serve.shutdown()
+    t.join(timeout=30)
+    # The in-flight request was NOT cut off by the shutdown.
+    assert out and out[0][0] == 200, out
+    # And the listener is gone: new connections are refused.
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", port), timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Handle long-poll lifecycle (regression: poller used to spin forever
+# retrying the dead controller after serve.shutdown()).
+# ---------------------------------------------------------------------------
+def test_poll_loop_exits_after_shutdown(serve_instance):
+    @serve.deployment
+    def ping(request):
+        return "pong"
+
+    handle = serve.run(ping.bind())
+    assert handle.remote({}).result(timeout=60) == "pong"
+    assert any(t.name == "serve-router-longpoll"
+               for t in threading.enumerate())
+    serve.shutdown()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if not any(t.name == "serve-router-longpoll" and t.is_alive()
+                   for t in threading.enumerate()):
+            return
+        time.sleep(0.2)
+    pytest.fail("serve-router-longpoll thread still alive after shutdown")
+
+
+# ---------------------------------------------------------------------------
+# Chaos soak: ~2x capacity under seeded latency + one-way partition.
+# ---------------------------------------------------------------------------
+SOAK_SCRIPT = """
+import json, os, threading, time, urllib.error, urllib.request
+
+os.environ["RAY_TPU_CHAOS_SEED"] = "808"
+os.environ["RAY_TPU_CHAOS_DELAY_MS"] = "*push_task*=0:30:0.5,recv.heartbeat=0:20"
+os.environ["RAY_TPU_CHAOS_PARTITION"] = "heartbeat:recv:0.2"
+
+import ray_tpu
+from ray_tpu import serve
+
+ray_tpu.init(num_cpus=8, object_store_memory=256 * 1024 * 1024)
+
+@serve.deployment(num_replicas=2, max_ongoing_requests=2,
+                  max_queued_requests=2, request_timeout_s=8,
+                  graceful_shutdown_timeout_s=10)
+class Work:
+    def __call__(self, request):
+        # Slow enough that 10 zero-think clients exceed capacity on any
+        # machine: 4 slots / 0.2s = 20 rps vs ~50 rps offered. At 0.05s
+        # the slots drained so fast that shedding became timing-dependent.
+        time.sleep(0.2)
+        return {"ok": True}
+
+serve.run(Work.bind(), route_prefix="/work")
+port = serve.http_port()
+
+# Offered load over 2x capacity: 2 replicas x 2 slots = 4 executing
+# (+2 queued at the handle); 10 closed-loop clients with zero think
+# time keep the system past saturation for the whole window.
+results, lock = [], threading.Lock()
+stop_at = time.time() + 20
+
+def client():
+    while time.time() < stop_at:
+        t0 = time.time()
+        try:
+            req = urllib.request.Request(
+                "http://127.0.0.1:%d/work" % port, data=b"{}",
+                headers={"content-type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                code, retry_after = r.status, None
+                r.read()
+        except urllib.error.HTTPError as e:
+            code, retry_after = e.code, e.headers.get("retry-after")
+            e.read(); e.close()
+        except Exception:
+            code, retry_after = -1, None
+        with lock:
+            results.append((code, time.time() - t0, retry_after))
+
+threads = [threading.Thread(target=client) for _ in range(10)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(timeout=120)
+# EVERY request terminates: no thread may still be wedged in a request.
+assert not any(t.is_alive() for t in threads), "client hung"
+codes = [c for c, _, _ in results]
+assert codes, "no requests completed at all"
+assert -1 not in codes, "client-side timeout/hang observed"
+assert set(codes) <= {200, 429, 503, 504}, set(codes)
+ok_lat = sorted(lat for c, lat, _ in results if c == 200)
+shed = [(c, ra) for c, _, ra in results if c in (429, 503, 504)]
+assert ok_lat, "overload starved ALL requests — shedding collapsed goodput"
+assert shed, "never shed at 2x capacity — admission control inert"
+# Every 429/503 carries Retry-After so clients can pace themselves.
+assert all(ra == "1" for c, ra in shed if c in (429, 503)), shed[:5]
+# Accepted-request p99 stays bounded by the deadline (+ margin), i.e.
+# accepted work is not serialized behind an unbounded queue.
+p99 = ok_lat[min(len(ok_lat) - 1, int(len(ok_lat) * 0.99))]
+assert p99 < 12.0, p99
+print("LOAD_DONE total=%d ok=%d shed=%d p99=%.2f"
+      % (len(results), len(ok_lat), len(shed), p99), flush=True)
+
+# The shed metric must account for every shed the clients observed:
+# proxy-stage reasons map 1:1 onto non-200 responses.
+from ray_tpu.util import metrics as um
+PROXY_REASONS = {"backpressure", "proxy_capacity", "timeout", "no_replica",
+                 "replica_died", "draining", "body_too_large",
+                 "headers_too_large"}
+deadline = time.time() + 30
+metric = -1
+while time.time() < deadline:
+    m = um.query_metrics().get("ray_tpu_serve_shed_total", {"values": {}})
+    metric = sum(v for tags, v in m["values"].items()
+                 if dict(tags).get("reason") in PROXY_REASONS)
+    if metric >= len(shed):
+        break
+    time.sleep(1.0)
+assert metric == len(shed), (metric, len(shed))
+print("OVERLOAD_SOAK_OK", flush=True)
+serve.shutdown()
+ray_tpu.shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_overload_soak_under_chaos():
+    """ISSUE 8 acceptance: at ~2x capacity under seeded latency chaos and
+    a one-way heartbeat partition, every request terminates (success or
+    explicit shed), sheds carry Retry-After, accepted p99 stays bounded,
+    and ray_tpu_serve_shed_total reflects the observed shed count."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PYTHONPATH=repo_root, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", SOAK_SCRIPT], env=env,
+                         capture_output=True, text=True, timeout=420)
+    assert "OVERLOAD_SOAK_OK" in out.stdout, \
+        out.stdout[-1500:] + out.stderr[-3000:]
