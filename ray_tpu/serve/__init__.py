@@ -107,18 +107,29 @@ def start(http_port: int = 0, _with_http: bool = True,
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
+        # Controller creation is DECOUPLED from proxy creation: after a
+        # controller crash/restart the proxy is usually still alive (it
+        # re-resolves the new controller by name), and the restarted
+        # controller recovers its ports from its checkpoint — creating a
+        # second proxy here would orphan the one clients point at.
         Controller = ray_tpu.remote(ServeController)
         controller = Controller.options(
             name=CONTROLLER_NAME, max_concurrency=16, num_cpus=0.5,
         ).remote()
         ray_tpu.get(controller.start_loops.remote(), timeout=60)
-        if _with_http:
+    if _with_http:
+        try:
+            proxy = ray_tpu.get_actor("SERVE_PROXY")
+            port = ray_tpu.get(proxy.port.remote(), timeout=30)
+        except Exception:
             from ray_tpu.serve._proxy import ProxyActor
 
             Proxy = ray_tpu.remote(ProxyActor)
             proxy = Proxy.options(name="SERVE_PROXY", max_concurrency=64,
                                   num_cpus=0.5).remote(http_port)
             port = ray_tpu.get(proxy.start.remote(), timeout=60)
+        if ray_tpu.get(controller.get_http_port.remote(),
+                       timeout=30) != port:
             ray_tpu.get(controller.set_http_port.remote(port), timeout=30)
     if grpc_port is not None and ray_tpu.get(
             controller.get_grpc_port.remote(), timeout=30) is None:

@@ -1,0 +1,274 @@
+"""Shed-aware serve autoscaling: signal tracking + decision policy.
+
+Reference: serve/_private/autoscaling_state.py (per-deployment
+AutoscalingState: replica metric reports with staleness, delay windows,
+smoothed desired-replica math) + serve/autoscaling_policy.py.
+
+Redesign notes, and why this is not the old ``_autoscale``:
+
+* **Push, not poll.** The controller no longer walks replicas with serial
+  blocking ``num_ongoing_requests`` gets. Replicas push
+  ``{ongoing, shed_delta}`` on their heartbeat path and ingress tiers
+  (handles, proxies) piggyback ``{queued, shed_delta}`` on the routing
+  calls they already make; this module just records timestamped reports.
+* **Staleness is load, not idleness.** A replica that has not reported
+  within ``load_report_staleness_s`` is counted AT CAPACITY, and any
+  staleness vetoes scale-down outright. The old code's
+  ``except Exception: pass`` counted an unreachable replica as zero load,
+  so node failures read as "idle" and drove scale-down exactly when
+  capacity was dying.
+* **Shed rate is a first-class signal.** Ongoing-request counts saturate
+  at the hard ``max_ongoing_requests`` cap: at 2x offered load every
+  replica reads exactly the cap, desired == current, and the deployment
+  sheds forever. The shed rate (requests/s rejected by overload control)
+  is the part of demand the ongoing gauge cannot see; it is folded into
+  the load estimate with its own EMA and weight.
+* **Flap control.** Hysteresis delay windows (a decision must SUSTAIN for
+  ``upscale_delay_s``/``downscale_delay_s``), a post-decision cooldown,
+  and a bounded per-cycle step keep chaotic signals from thrashing the
+  replica set.
+
+Everything here is pure in-process state — no runtime imports, no RPC —
+so the signal math is unit-testable inside the tier-1 window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+# Knob defaults, overridable per-deployment via ``autoscaling_config``.
+DEFAULTS: Dict[str, float] = {
+    "min_replicas": 1,
+    "target_ongoing_requests": 1.0,
+    "upscale_delay_s": 3.0,
+    "downscale_delay_s": 10.0,
+    "smoothing_factor": 0.6,
+    # Sheds/sec are converted into equivalent ongoing-request demand with
+    # this weight; below the threshold the term is treated as noise.
+    "shed_rate_weight": 1.0,
+    "shed_rate_threshold": 0.1,
+    # Refractory period after an APPLIED decision (on top of the delay
+    # windows) so actuation latency never double-fires a decision.
+    "upscale_cooldown_s": 2.0,
+    "downscale_cooldown_s": 5.0,
+    # Bounded actuation: one cycle never adds/removes more than this.
+    "max_step_per_cycle": 4,
+    # A replica/ingress report older than this is stale.
+    "load_report_staleness_s": 10.0,
+}
+
+# Ingress reporters (handles come and go with client processes) are
+# forgotten entirely after this long without a report.
+_INGRESS_FORGET_S = 60.0
+
+
+def resolve_config(ac: Optional[Dict[str, Any]],
+                   fallback_max: int) -> Dict[str, Any]:
+    """Merge a deployment's autoscaling_config over the defaults.
+    ``max_replicas`` falls back to the deployment's configured
+    num_replicas so a bare config never scales past what was asked for."""
+    cfg = dict(DEFAULTS)
+    cfg["max_replicas"] = fallback_max
+    cfg.update(ac or {})
+    cfg["min_replicas"] = max(0, int(cfg["min_replicas"]))
+    cfg["max_replicas"] = max(int(cfg["max_replicas"]), cfg["min_replicas"], 1)
+    cfg["target_ongoing_requests"] = max(
+        0.1, float(cfg["target_ongoing_requests"]))
+    cfg["smoothing_factor"] = min(
+        1.0, max(0.05, float(cfg["smoothing_factor"])))
+    cfg["max_step_per_cycle"] = max(1, int(cfg["max_step_per_cycle"]))
+    return cfg
+
+
+@dataclasses.dataclass
+class Decision:
+    """An applied autoscaling decision (for logging/metrics; the caller
+    mutates the deployment config with ``desired``)."""
+
+    desired: int
+    direction: str  # "up" | "down"
+    reason: str     # "ongoing" | "shed" | "idle"
+    load: float     # smoothed load estimate that drove it
+    shed_rate: float
+    stale: int      # replicas counted at capacity for missing reports
+
+
+class DeploymentAutoscaler:
+    """Per-deployment load tracker + decision loop state.
+
+    The controller owns one per autoscaling deployment, records reports
+    as they arrive (cheap, lock held by the caller), and calls ``tick``
+    once per reconcile round with wall-clock ``now``. Wall clock (not
+    monotonic) so checkpointed state survives a controller restart in a
+    different process."""
+
+    def __init__(self) -> None:
+        # rid -> (ongoing, reported_at)
+        self._replica_reports: Dict[str, tuple] = {}
+        # reporter id -> (queued, reported_at)
+        self._ingress_reports: Dict[str, tuple] = {}
+        # Sheds accumulated since the last tick (replica + ingress deltas).
+        self._shed_accum = 0.0
+        self._ema: Optional[float] = None
+        self._shed_rate_ema = 0.0
+        self._up_since: Optional[float] = None
+        self._down_since: Optional[float] = None
+        self._cooldown_until = 0.0
+        self._last_tick: Optional[float] = None
+        self.last_desired: Optional[int] = None
+
+    # -- signal intake ---------------------------------------------------
+    def record_replica(self, rid: str, ongoing: int, shed_delta: float,
+                       now: float) -> None:
+        self._replica_reports[rid] = (max(0, int(ongoing)), now)
+        if shed_delta > 0:
+            self._shed_accum += shed_delta
+
+    def record_ingress(self, reporter: str, queued: int, shed_delta: float,
+                       now: float) -> None:
+        self._ingress_reports[reporter] = (max(0, int(queued)), now)
+        if shed_delta > 0:
+            self._shed_accum += shed_delta
+
+    def forget_replica(self, rid: str) -> None:
+        """Drop a removed replica's report so it neither reads as load
+        nor as staleness once the controller has let go of it."""
+        self._replica_reports.pop(rid, None)
+
+    def replica_loads(self, replica_ids: Sequence[str], staleness_s: float,
+                      now: float) -> Dict[str, Optional[int]]:
+        """Latest ongoing count per replica; None = stale/unreported
+        (callers must treat None as at-capacity, never idle)."""
+        out: Dict[str, Optional[int]] = {}
+        for rid in replica_ids:
+            rep = self._replica_reports.get(rid)
+            out[rid] = (rep[0] if rep is not None
+                        and now - rep[1] <= staleness_s else None)
+        return out
+
+    # -- decision --------------------------------------------------------
+    def tick(self, current: int, replica_ids: Sequence[str],
+             max_ongoing: int, ac: Optional[Dict[str, Any]],
+             now: float, fallback_max: int = 1) -> Optional[Decision]:
+        cfg = resolve_config(ac, fallback_max)
+        staleness = float(cfg["load_report_staleness_s"])
+        at_capacity = max(1, int(max_ongoing))  # cap 0 = unbounded: count 1
+        total_ongoing = 0.0
+        stale = 0
+        for rid, ongoing in self.replica_loads(
+                replica_ids, staleness, now).items():
+            if ongoing is None:
+                total_ongoing += at_capacity
+                stale += 1
+            else:
+                total_ongoing += ongoing
+        queued = 0.0
+        for reporter, (q, ts) in list(self._ingress_reports.items()):
+            if now - ts > _INGRESS_FORGET_S:
+                del self._ingress_reports[reporter]
+            elif now - ts <= staleness:
+                queued += q
+        # Shed rate over the tick interval, then smoothed.
+        alpha = cfg["smoothing_factor"]
+        if self._last_tick is not None:
+            dt = max(1e-3, now - self._last_tick)
+            inst_rate = self._shed_accum / dt
+            self._shed_rate_ema = (alpha * inst_rate
+                                   + (1 - alpha) * self._shed_rate_ema)
+        self._shed_accum = 0.0
+        self._last_tick = now
+        shed_term = (cfg["shed_rate_weight"] * self._shed_rate_ema
+                     if self._shed_rate_ema >= cfg["shed_rate_threshold"]
+                     else 0.0)
+        load = total_ongoing + queued + shed_term
+        self._ema = (load if self._ema is None
+                     else alpha * load + (1 - alpha) * self._ema)
+        target = cfg["target_ongoing_requests"]
+        lo, hi = int(cfg["min_replicas"]), int(cfg["max_replicas"])
+        step = int(cfg["max_step_per_cycle"])
+        desired = max(lo, min(hi, math.ceil(self._ema / target) or lo))
+        # Bounded per-cycle actuation (after clamps so min/max always win
+        # eventually, over several cycles).
+        desired = max(current - step, min(current + step, desired))
+        self.last_desired = desired
+
+        if desired > current:
+            self._down_since = None
+            if self._up_since is None:
+                self._up_since = now
+            if (now >= self._cooldown_until
+                    and now - self._up_since >= float(cfg["upscale_delay_s"])):
+                self._up_since = None
+                self._cooldown_until = now + float(cfg["upscale_cooldown_s"])
+                # "shed" when the saturating signal (ongoing+queued alone)
+                # would NOT have grown the deployment — the capped-but-
+                # shedding case the old policy could never escape.
+                base_desired = max(lo, min(hi, math.ceil(
+                    (total_ongoing + queued) / target) or lo))
+                reason = "shed" if (shed_term > 0
+                                    and base_desired <= current) \
+                    else "ongoing"
+                return Decision(desired, "up", reason, self._ema,
+                                self._shed_rate_ema, stale)
+        elif desired < current:
+            self._up_since = None
+            if stale:
+                # A stale or unreachable replica must never read as idle:
+                # veto scale-down until every live replica reports again.
+                self._down_since = None
+                return None
+            if self._down_since is None:
+                self._down_since = now
+            if (now >= self._cooldown_until
+                    and now - self._down_since
+                    >= float(cfg["downscale_delay_s"])):
+                self._down_since = None
+                self._cooldown_until = (now
+                                        + float(cfg["downscale_cooldown_s"]))
+                return Decision(desired, "down", "idle", self._ema,
+                                self._shed_rate_ema, stale)
+        else:
+            self._up_since = self._down_since = None
+        return None
+
+    # -- durability ------------------------------------------------------
+    # Windows/cooldowns are wall-clock absolutes, so a restarted
+    # controller resumes the SAME delay windows instead of resetting them
+    # (an EMA/cooldown reset after every crash is a flap amplifier: the
+    # restarted loop re-observes the load spike from scratch and
+    # re-decides scale events it already actuated).
+    _STATE_FIELDS = ("_ema", "_shed_rate_ema", "_up_since", "_down_since",
+                     "_cooldown_until", "_last_tick", "last_desired")
+
+    def to_state(self) -> Dict[str, Any]:
+        state = {f: getattr(self, f) for f in self._STATE_FIELDS}
+        state["_shed_accum"] = self._shed_accum
+        return state
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "DeploymentAutoscaler":
+        a = cls()
+        for f in cls._STATE_FIELDS:
+            if f in state:
+                setattr(a, f, state[f])
+        a._shed_accum = float(state.get("_shed_accum", 0.0))
+        return a
+
+
+def pick_scale_down_victims(replicas: List[Any],
+                            loads: Dict[str, Optional[int]],
+                            count: int) -> List[Any]:
+    """Least-loaded victim selection for scale-down (reference:
+    deployment_state chooses replicas with the fewest ongoing requests to
+    stop). Unhealthy replicas go first (no point draining a healthy one
+    while a sick one exists); among healthy ones, the freshest-lowest
+    ongoing count wins; a stale report sorts LAST (unknown load = assume
+    busy, drain something provably quiet instead)."""
+    def key(info):
+        load = loads.get(info.replica_id)
+        return (0 if not getattr(info, "healthy", True) else 1,
+                float("inf") if load is None else load)
+
+    return sorted(replicas, key=key)[:max(0, count)]

@@ -46,3 +46,7 @@ class ReplicaInfo:
     actor: Any  # ActorHandle
     healthy: bool = True
     created_at: float = dataclasses.field(default_factory=time.monotonic)
+    # Passed health at least once: a replica dying BEFORE this is a boot
+    # failure (triggers per-deployment boot backoff); after it, a plain
+    # runtime death (replace immediately).
+    booted: bool = False

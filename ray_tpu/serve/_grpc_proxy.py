@@ -75,6 +75,8 @@ class GrpcProxyActor:
         self._deployments: Dict[str, Any] = {}  # name -> routing info
         self._version = -1
         self._server = None
+        # deployment -> sheds since the last delivered ingress report.
+        self._shed_accum: Dict[str, int] = {}
         from ray_tpu.util import metrics as um
 
         self._m_shed = um.get_counter(
@@ -117,8 +119,7 @@ class GrpcProxyActor:
                 except Exception as e:  # noqa: BLE001
                     code, reason = _grpc_overload_status(e)
                     if code is not None:
-                        proxy._m_shed.inc(tags={"deployment": name,
-                                                "reason": reason})
+                        proxy._shed(name, reason)
                         await context.abort(code, repr(e))
                     await context.abort(grpc.StatusCode.INTERNAL, repr(e))
                 return _encode_payload(out, pb)
@@ -153,8 +154,7 @@ class GrpcProxyActor:
                     except Exception as e:  # noqa: BLE001
                         code, reason = _grpc_overload_status(e)
                         if code is not None and first:
-                            proxy._m_shed.inc(tags={"deployment": name,
-                                                    "reason": reason})
+                            proxy._shed(name, reason)
                             await context.abort(code, repr(e))
                         raise
                     if item is _END:
@@ -182,23 +182,53 @@ class GrpcProxyActor:
     def port(self) -> int:
         return self._port
 
+    def _shed(self, deployment: str, reason: str) -> None:
+        self._m_shed.inc(tags={"deployment": deployment, "reason": reason})
+        self._shed_accum[deployment] = (
+            self._shed_accum.get(deployment, 0) + 1)
+
+    def _take_ingress_report(self) -> Optional[Dict[str, Any]]:
+        if not self._shed_accum:
+            return None
+        accum, self._shed_accum = self._shed_accum, {}
+        return {"reporter": f"grpc-proxy:{self._port}",
+                "deployments": {name: {"queued": 0, "shed_delta": d}
+                                for name, d in accum.items()}}
+
+    def _restore_ingress_report(self,
+                                report: Optional[Dict[str, Any]]) -> None:
+        if not report:
+            return
+        for name, rep in report["deployments"].items():
+            self._shed_accum[name] = (self._shed_accum.get(name, 0)
+                                      + rep["shed_delta"])
+
     # -- routing shared with the HTTP plane ----------------------------
     async def _route_refresh_loop(self) -> None:
         loop = asyncio.get_running_loop()
+        # Re-resolve the controller handle after any failure (same fix as
+        # the HTTP proxy): polling a dead handle forever left the proxy
+        # blind across controller restarts.
         controller = None
-        while controller is None:
-            try:
-                controller = await loop.run_in_executor(
-                    None, lambda: ray_tpu.get_actor(CONTROLLER_NAME))
-            except Exception:
-                await asyncio.sleep(1.0)
-        self._controller = controller
         while True:
             try:
-                self._apply_routing(
-                    await controller.get_routing.remote(self._version))
+                if controller is None:
+                    controller = await loop.run_in_executor(
+                        None, lambda: ray_tpu.get_actor(CONTROLLER_NAME))
+                    self._controller = controller
+                report = self._take_ingress_report()
+                try:
+                    routing = await controller.get_routing.remote(
+                        self._version, report)
+                except Exception:
+                    self._restore_ingress_report(report)
+                    raise
+                self._apply_routing(routing)
             except Exception:
-                logger.exception("grpc route refresh failed")
+                if controller is not None:
+                    logger.warning("grpc route refresh failed; will "
+                                   "re-resolve controller", exc_info=True)
+                controller = None
             await asyncio.sleep(1.0)
 
     def _apply_routing(self, routing) -> None:

@@ -46,6 +46,13 @@ class _RouterCache:
         # Requests parked in backpressure-retry (the handle's bounded
         # pending queue; see DeploymentConfig.max_queued_requests).
         self.queued = 0
+        # Terminal sheds (queue full / deadline) since the last load
+        # report delivered to the controller — piggybacked on the
+        # long-poll as part of the autoscaling signal.
+        self.shed_delta = 0
+        import uuid as _uuid
+
+        self.reporter = "handle:" + _uuid.uuid4().hex[:8]
         # Multiplexing affinity: model_id -> replica_id last used for it
         # (reference: the router prefers replicas with the model loaded).
         self.model_replica: Dict[str, str] = {}
@@ -196,14 +203,36 @@ class DeploymentHandle:
         threading.Thread(target=self._poll_loop, daemon=True,
                          name="serve-router-longpoll").start()
 
+    def _take_load_report(self) -> Dict[str, Any]:
+        """Queue depth + terminal-shed delta for this deployment,
+        piggybacked on the routing long-poll (the handle tier's half of
+        the autoscaling signal — no extra RPC stream). The shed delta is
+        CONSUMED here; a failed delivery must give it back."""
+        c = self._cache
+        with c.lock:
+            delta, c.shed_delta = c.shed_delta, 0
+            queued = c.queued
+        return {"reporter": c.reporter,
+                "deployments": {self.deployment_name: {
+                    "queued": queued, "shed_delta": delta}}}
+
+    def _restore_load_report(self, report: Dict[str, Any]) -> None:
+        c = self._cache
+        delta = report["deployments"][self.deployment_name]["shed_delta"]
+        if delta:
+            with c.lock:
+                c.shed_delta += delta
+
     def _poll_loop(self) -> None:
         c = self._cache
         try:
             while True:
                 if _shutdown_event.is_set() or not ray_tpu.is_initialized():
                     return
+                report = None
                 try:
                     controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                    report = self._take_load_report()
                     # The long-poll parks in the controller for up to 25s.
                     # It MUST ride its own submission lane: batched with an
                     # ordinary call (get_http_port, deploy, ...) the shared
@@ -212,7 +241,7 @@ class DeploymentHandle:
                     routing = ray_tpu.get(
                         controller.wait_routing.options(
                             concurrency_group="_serve_longpoll",
-                        ).remote(c.version, 25.0),
+                        ).remote(c.version, 25.0, report),
                         timeout=40)
                     if routing is not None:
                         with c.lock:
@@ -220,6 +249,8 @@ class DeploymentHandle:
                             c.deployments = routing["deployments"]
                             c.fetched_at = time.monotonic()
                 except Exception:
+                    if report is not None:
+                        self._restore_load_report(report)
                     # Controller restarting: back off, retry — but a
                     # serve.shutdown() means it is gone for GOOD; without
                     # the latch check this thread would spin forever.
@@ -347,6 +378,10 @@ class DeploymentHandle:
             "max_queued_requests", 64))
         with c.lock:
             if c.queued >= max_queued:
+                # Terminal shed (counted once, not per retry attempt):
+                # demand the replica tier never saw — report it so the
+                # autoscaler can turn it into capacity.
+                c.shed_delta += 1
                 raise BackPressureError(
                     f"pending queue full for deployment "
                     f"{self.deployment_name!r} "
@@ -381,6 +416,8 @@ class DeploymentHandle:
                 d = delay_for_attempt(attempt, initial=0.02, maximum=0.5)
                 attempt += 1
                 if time.monotonic() + d >= deadline:
+                    with self._cache.lock:
+                        self._cache.shed_delta += 1
                     raise BackPressureError(
                         f"request to {self.deployment_name!r} still shed "
                         f"at deadline after {attempt} attempts"
@@ -412,6 +449,8 @@ class DeploymentHandle:
 
         d = delay_for_attempt(attempt, initial=0.02, maximum=0.5)
         if time.monotonic() + d >= deadline:
+            with self._cache.lock:
+                self._cache.shed_delta += 1
             raise BackPressureError(
                 f"stream request to {self.deployment_name!r} still shed "
                 f"at deadline") from first_exc

@@ -73,6 +73,8 @@ class ProxyActor:
         self._ongoing = 0
         self._ready = False
         self._draining = False
+        # deployment -> sheds since the last delivered ingress report.
+        self._shed_accum: Dict[str, int] = {}
         from ray_tpu.util import metrics as um
 
         self._m_shed = um.get_counter(
@@ -108,25 +110,54 @@ class ProxyActor:
             await asyncio.sleep(0.02)
         return self._ongoing
 
+    def _take_ingress_report(self) -> Optional[Dict[str, Any]]:
+        """Shed deltas accumulated per deployment since the last delivered
+        report — piggybacked on the routing poll so proxy-tier sheds feed
+        the autoscaler with no extra RPC stream. None when quiet.
+        Event-loop-only state: no lock needed."""
+        if not self._shed_accum:
+            return None
+        accum, self._shed_accum = self._shed_accum, {}
+        return {"reporter": f"http-proxy:{self._port}",
+                "deployments": {name: {"queued": 0, "shed_delta": d}
+                                for name, d in accum.items()}}
+
+    def _restore_ingress_report(self,
+                                report: Optional[Dict[str, Any]]) -> None:
+        if not report:
+            return
+        for name, rep in report["deployments"].items():
+            self._shed_accum[name] = (self._shed_accum.get(name, 0)
+                                      + rep["shed_delta"])
+
     async def _route_refresh_loop(self) -> None:
         loop = asyncio.get_running_loop()
-        # get_actor is a blocking driver-style call — it must run on an
-        # executor thread, never on this event loop (it would deadlock the
-        # proxy's accept loop).
+        # The controller handle is RE-resolved after any failure: the old
+        # loop resolved once and then polled a dead handle forever, so a
+        # controller restart left every proxy blind until ITS restart.
         controller = None
-        while controller is None:
-            try:
-                controller = await loop.run_in_executor(
-                    None, lambda: ray_tpu.get_actor(CONTROLLER_NAME))
-            except Exception:
-                await asyncio.sleep(1.0)
-        self._controller = controller
         while True:
             try:
-                self._apply_routing(
-                    await controller.get_routing.remote(self._version))
+                if controller is None:
+                    # get_actor is a blocking driver-style call — it must
+                    # run on an executor thread, never on this event loop
+                    # (it would deadlock the proxy's accept loop).
+                    controller = await loop.run_in_executor(
+                        None, lambda: ray_tpu.get_actor(CONTROLLER_NAME))
+                    self._controller = controller
+                report = self._take_ingress_report()
+                try:
+                    routing = await controller.get_routing.remote(
+                        self._version, report)
+                except Exception:
+                    self._restore_ingress_report(report)
+                    raise
+                self._apply_routing(routing)
             except Exception:
-                logger.exception("route refresh failed")
+                if controller is not None:
+                    logger.warning("route refresh failed; will re-resolve "
+                                   "controller", exc_info=True)
+                controller = None
             await asyncio.sleep(1.0)
 
     def _apply_routing(self, routing) -> None:
@@ -234,6 +265,11 @@ class ProxyActor:
 
     def _shed(self, deployment: str, reason: str) -> None:
         self._m_shed.inc(tags={"deployment": deployment, "reason": reason})
+        if deployment != "-":
+            # "-" sheds (unrouted / malformed) have no deployment to
+            # scale; everything else feeds the autoscaling signal.
+            self._shed_accum[deployment] = (
+                self._shed_accum.get(deployment, 0) + 1)
 
     def _timeout_for(self, name: str) -> float:
         info = self._deployments.get(name) or {}

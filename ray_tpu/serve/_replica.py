@@ -11,11 +11,18 @@ import inspect
 from typing import Any, Dict, Optional, Tuple
 
 
+# How often a serve-managed replica pushes its load report to the
+# controller (the primary autoscaling signal; check_health piggyback is
+# the fallback when this thread is partitioned away).
+REPORT_PERIOD_S = 0.5
+
+
 class ReplicaActor:
     def __init__(self, serialized_ctor, init_args: Tuple, init_kwargs: Dict,
                  user_config: Optional[Dict[str, Any]] = None,
                  deployment_name: str = "",
-                 max_ongoing_requests: int = 0):
+                 max_ongoing_requests: int = 0,
+                 replica_id: str = ""):
         import cloudpickle
 
         ctor = cloudpickle.loads(serialized_ctor)
@@ -41,6 +48,11 @@ class ReplicaActor:
         # Draining: set by prepare_for_shutdown before the controller kills
         # this replica; new requests shed, in-flight ones run to completion.
         self._draining = False
+        # Sheds since the last load report was taken (push or health
+        # piggyback): the controller turns these deltas into the shed-rate
+        # autoscaling term.
+        self._shed_since_report = 0
+        self._replica_id = replica_id
         # Serve request metrics (reference: serve/_private/metrics —
         # the names the shipped Grafana serve dashboard charts). Counted
         # here, at the replica, so handle calls and HTTP both register.
@@ -64,6 +76,13 @@ class ReplicaActor:
             "ray_tpu_serve_shed_total",
             "Serve requests shed by overload control, by stage/reason",
             tag_keys=("deployment", "reason"))
+        # Push-based load reporting: only when serve-managed (a
+        # deployment name AND replica id were assigned by the controller).
+        # Direct ReplicaActor use (legacy/tests) has no controller to
+        # report to.
+        if deployment_name and replica_id:
+            threading.Thread(target=self._report_loop, daemon=True,
+                             name="serve-replica-report").start()
 
     def _resolve_method(self, method_name: str):
         if callable(self._callable) and method_name == "__call__":
@@ -131,6 +150,7 @@ class ReplicaActor:
                 # Admission check is atomic with the increment — two
                 # racing over-cap requests must not both slip under it.
                 if self._draining:
+                    self._shed_since_report += 1
                     self._m_shed.inc(tags={"deployment": dep,
                                            "reason": "replica_draining"})
                     from ray_tpu.exceptions import BackPressureError
@@ -138,6 +158,7 @@ class ReplicaActor:
                     raise BackPressureError(
                         f"replica of {dep!r} is draining for shutdown")
                 if self._max_ongoing and self._ongoing >= self._max_ongoing:
+                    self._shed_since_report += 1
                     self._m_shed.inc(tags={"deployment": dep,
                                            "reason": "replica_capacity"})
                     from ray_tpu.exceptions import BackPressureError
@@ -169,6 +190,58 @@ class ReplicaActor:
         with self._ongoing_lock:
             return self._ongoing
 
+    # -- load reporting (the push half of the autoscaling signal) --------
+    def _take_load_report(self) -> Dict[str, Any]:
+        """Atomically snapshot ongoing + consume the shed delta. Callers
+        that fail to DELIVER the report must give the delta back via
+        _restore_shed_delta, or those sheds vanish from the signal."""
+        with self._ongoing_lock:
+            delta = self._shed_since_report
+            self._shed_since_report = 0
+            return {"ongoing": self._ongoing, "shed_delta": delta,
+                    "draining": self._draining}
+
+    def _restore_shed_delta(self, delta: int) -> None:
+        if delta > 0:
+            with self._ongoing_lock:
+                self._shed_since_report += delta
+
+    def _report_loop(self) -> None:
+        """Push `{ongoing, shed_delta}` to the controller every
+        REPORT_PERIOD_S. The delivery is confirmed (get with a short
+        timeout) so a failed push restores its shed delta; the controller
+        handle is re-resolved after any failure — it survives controller
+        restarts by name."""
+        import time
+
+        from ray_tpu._private.backoff import delay_for_attempt
+        from ray_tpu.serve._common import CONTROLLER_NAME
+
+        import ray_tpu
+
+        controller = None
+        failures = 0
+        while True:
+            report = None
+            try:
+                if controller is None:
+                    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                report = self._take_load_report()
+                ray_tpu.get(
+                    controller.report_replica_load.remote(
+                        self._deployment_name, self._replica_id,
+                        report["ongoing"], report["shed_delta"]),
+                    timeout=5)
+                failures = 0
+                time.sleep(REPORT_PERIOD_S)
+            except Exception:
+                if report is not None:
+                    self._restore_shed_delta(report["shed_delta"])
+                controller = None
+                failures += 1
+                time.sleep(delay_for_attempt(failures - 1,
+                                             initial=0.2, maximum=5.0))
+
     def prepare_for_shutdown(self, timeout_s: float = 10.0) -> int:
         """Graceful drain (reference: replica.py perform_graceful_shutdown):
         stop admitting — new requests shed with BackPressureError so the
@@ -194,8 +267,16 @@ class ReplicaActor:
         if callable(reconfigure):
             reconfigure(user_config)
 
-    def check_health(self) -> bool:
+    def check_health(self) -> Dict[str, Any]:
+        """Health verdict with the load report piggybacked (reference:
+        autoscaling metrics ride the replica's existing control channel) —
+        the controller's poll-based fallback signal when the push thread
+        is partitioned away. Raises if the user check raises (unhealthy);
+        a dict return is truthy, so bool-expecting callers still work."""
         user_check = getattr(self._callable, "check_health", None)
         if callable(user_check):
             user_check()
-        return True
+        rep = self._take_load_report()
+        return {"healthy": True, "ongoing": rep["ongoing"],
+                "shed_delta": rep["shed_delta"],
+                "draining": rep["draining"]}

@@ -41,6 +41,7 @@ class DeploymentOverride:
     graceful_shutdown_timeout_s: Optional[float] = None
     user_config: Optional[Dict[str, Any]] = None
     ray_actor_options: Optional[Dict[str, Any]] = None
+    autoscaling_config: Optional[Dict[str, Any]] = None
 
     @classmethod
     def parse(cls, raw: Dict[str, Any]) -> "DeploymentOverride":
@@ -121,6 +122,14 @@ class ApplicationSchema:
                     dep.user_config = ov.user_config
                 if ov.ray_actor_options is not None:
                     dep.ray_actor_options = ov.ray_actor_options
+                if ov.autoscaling_config is not None:
+                    from ray_tpu.serve._autoscaling import resolve_config
+
+                    # Validate knob values up front (bad types raise here,
+                    # at deploy time, not inside the reconcile thread).
+                    resolve_config(ov.autoscaling_config,
+                                   dep.num_replicas)
+                    dep.autoscaling_config = ov.autoscaling_config
             for a in list(node.args) + list(node.kwargs.values()):
                 if isinstance(a, Application):
                     stack.append(a)

@@ -558,3 +558,38 @@ def test_overload_soak_under_chaos():
                          capture_output=True, text=True, timeout=420)
     assert "OVERLOAD_SOAK_OK" in out.stdout, \
         out.stdout[-1500:] + out.stderr[-3000:]
+
+
+def test_shed_signal_drives_scale_up_past_ongoing_cap():
+    """Overload-control sheds feed the autoscaler: a deployment pinned at
+    max_ongoing_requests reads desired == current on the ongoing gauge
+    alone (it saturates at the cap), but the shed deltas that proxies,
+    handles, and replicas piggyback on their reports must still drive a
+    scale-up decision — the closed loop that turns load shedding into
+    recovery instead of a steady state."""
+    from ray_tpu.serve._autoscaling import DeploymentAutoscaler
+
+    ac = {"min_replicas": 1, "max_replicas": 6,
+          "target_ongoing_requests": 2.0, "upscale_delay_s": 1.0,
+          "upscale_cooldown_s": 1.0, "smoothing_factor": 0.8}
+    a = DeploymentAutoscaler()
+    rids = ["r1", "r2"]
+    decision = None
+    for i in range(8):
+        t = float(i)
+        # Every replica pinned exactly at the cap (2 ongoing of 2)...
+        for rid in rids:
+            a.record_replica(rid, 2, 1.0, t)        # replica-side sheds
+        # ...while the ingress tiers report the sheds they observed.
+        a.record_ingress("http-proxy:8000", 0, 3.0, t)
+        a.record_ingress("handle:abcd1234", 0, 1.0, t)
+        decision = a.tick(2, rids, 2, ac, t)
+        if decision:
+            break
+    assert decision is not None, "capped-but-shedding never scaled up"
+    assert decision.direction == "up"
+    assert decision.reason == "shed"
+    assert decision.desired > 2
+    # The decision was driven by the shed-rate EMA, not the (saturated)
+    # ongoing gauge: ~6 sheds/s across the tiers, smoothed.
+    assert decision.shed_rate > 2.0
