@@ -59,6 +59,36 @@ def _headline_from_model_benches(tpu):
     return headline or None
 
 
+def _overhead_snapshot():
+    """Driver-side per-call overhead decomposition (flight recorder),
+    printed as a stderr table and returned for the JSON payloads. Never
+    fails the bench: returns None when the recorder is off/empty."""
+    try:
+        from ray_tpu._private import flight_recorder as _fr
+
+        out = _fr.overhead_breakdown()
+        if not out:
+            return None
+        hdr = ("fn", "n", "e2e_us", "ser", "frame", "sysc",
+               "disp", "exec", "reply", "wire", "cover")
+        print("overhead breakdown (mean us/call, sampled):", file=sys.stderr)
+        print("  " + " ".join(f"{h:>8}" for h in hdr), file=sys.stderr)
+        for fn, phases in sorted(out.items()):
+            e2e = phases.get("e2e", {})
+            row = [fn[:8], str(e2e.get("count", 0)),
+                   f"{e2e.get('mean_us', 0):.1f}"]
+            for p in ("serialize", "frame", "syscall", "dispatch",
+                      "exec", "reply", "wire"):
+                row.append(f"{phases.get(p, {}).get('mean_us', 0):.1f}")
+            row.append(f"{phases.get('coverage', 0):.2f}")
+            print("  " + " ".join(f"{c:>8}" for c in row), file=sys.stderr)
+        return out
+    except Exception as e:  # noqa: BLE001
+        print(f"overhead snapshot skipped: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return None
+
+
 def bench_actor_calls_sync(ray_tpu, n=2000):
     @ray_tpu.remote
     class Echo:
@@ -268,6 +298,12 @@ def main():
         async_rate = bench_actor_calls_async(ray_tpu)
         task_rate = bench_tasks_async(ray_tpu)
         put_gbps = bench_put_gigabytes(ray_tpu)
+        # Per-call overhead decomposition from the flight recorder,
+        # sampled across the control-plane benches above: where each µs
+        # of a call went (serialize/frame/syscall/dispatch/exec/reply/
+        # wire) — the "which function do I optimize" companion to the
+        # rates (ROADMAP item 1).
+        overhead = _overhead_snapshot()
         try:
             from ray_tpu.benchmarks import mnist_trainer_bench
 
@@ -321,7 +357,8 @@ def main():
             with open(os.path.join(os.path.dirname(__file__) or ".",
                                    "MICROBENCH.json"), "w") as f:
                 json.dump({"host": "1-core driver host",
-                           "results": table}, f, indent=1)
+                           "results": table,
+                           "overhead_breakdown": overhead}, f, indent=1)
         except Exception as e:  # noqa: BLE001
             print(f"micro benchmark table skipped: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -378,6 +415,7 @@ def main():
             "vs_baseline": round(sync_rate / BASELINE_1_1_ACTOR_CALLS_SYNC, 3),
             "headline": _headline_from_model_benches(tpu),
             "control_plane": control_plane,
+            "overhead_breakdown": overhead,
         }, default=float))
     finally:
         ray_tpu.shutdown()

@@ -18,8 +18,10 @@ import itertools
 import pickle
 import struct
 import threading
+import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
+from ray_tpu._private import flight_recorder as _fr
 from ray_tpu._private.chaos import RECV, SEND, get_chaos
 from ray_tpu.utils.config import get_config
 from ray_tpu.utils.logging import get_logger
@@ -63,7 +65,8 @@ def _loads(body: bytes, buffers: list) -> Any:
 _LARGE_BUF = 1 << 20
 
 
-def _frame_parts(kind: int, msg_id: int, obj: Any) -> list:
+def _frame_parts(kind: int, msg_id: int, obj: Any, lane: str = "async",
+                 rec: Optional[dict] = None) -> list:
     """Build the wire representation of one frame as a list of buffers.
 
     Small frames coalesce into ONE buffer (one socket send): separate
@@ -71,8 +74,18 @@ def _frame_parts(kind: int, msg_id: int, obj: Any) -> list:
     a single-core host each packet can wake the peer early — measured at
     ~45µs per send syscall, i.e. ~90µs of avoidable latency per frame.
     Large out-of-band buffers stay separate to avoid copying them.
+
+    ``rec`` is a sampled flight-recorder call record: when present, the
+    serialize/frame split is stamped into it. Wire accounting (frames,
+    bytes, parts before/after coalescing) is always-on plain-int adds.
     """
-    body, oob = _dumps(obj)
+    if rec is not None:
+        t0 = time.perf_counter_ns()
+        body, oob = _dumps(obj)
+        t1 = time.perf_counter_ns()
+        rec["serialize_ns"] = rec.get("serialize_ns", 0) + (t1 - t0)
+    else:
+        body, oob = _dumps(obj)
     head = [_HEADER.pack(kind, msg_id, len(oob)),
             struct.pack(">Q", len(body)), body]
     parts: list = []
@@ -87,24 +100,51 @@ def _frame_parts(kind: int, msg_id: int, obj: Any) -> list:
             small.append(buf)
     if small:
         parts.append(b"".join(small) if len(small) > 1 else small[0])
+    if rec is not None:
+        rec["frame_ns"] = time.perf_counter_ns() - t1
+    if _fr._ENABLED:
+        nbytes = 0
+        for p in parts:
+            nbytes += len(p)
+        # One fused accounting call per frame (wire_tx also folds in the
+        # send-syscall count and the sampled size observe). Async parts
+        # hit write() as-is; the fast lane joins them into one sendall.
+        _fr.wire_tx(kind, lane, nbytes, 3 + 2 * len(oob),
+                    len(parts) if lane == "async" else 1)
     return parts
 
 
 def _write_frame_sync(writer: asyncio.StreamWriter, kind: int, msg_id: int,
-                      obj: Any) -> None:
+                      obj: Any, rec: Optional[dict] = None) -> None:
     """Queue a frame on the transport without awaiting drain — callers on
     the hot path rely on the transport's own buffering; use the async
     variant when flow control matters (large payloads)."""
-    for part in _frame_parts(kind, msg_id, obj):
-        writer.write(part)
+    parts = _frame_parts(kind, msg_id, obj, rec=rec)
+    if rec is not None:
+        t0 = time.perf_counter_ns()
+        for part in parts:
+            writer.write(part)
+        rec["syscall_ns"] = time.perf_counter_ns() - t0
+    else:
+        for part in parts:
+            writer.write(part)
 
 
 async def _write_frame(
     writer: asyncio.StreamWriter, kind: int, msg_id: int, obj: Any
 ) -> None:
-    for part in _frame_parts(kind, msg_id, obj):
+    parts = _frame_parts(kind, msg_id, obj)
+    for part in parts:
         writer.write(part)
-    await writer.drain()
+    if _fr._ENABLED:
+        t0 = time.perf_counter_ns()
+        await writer.drain()
+        dt = (time.perf_counter_ns() - t0) / 1e9
+        # Only a drain that actually waited is backpressure worth recording.
+        if dt > 0.0005:
+            _fr.note_drain_stall(dt)
+    else:
+        await writer.drain()
 
 
 async def _read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
@@ -122,17 +162,21 @@ async def _read_frame(reader: asyncio.StreamReader) -> Tuple[int, int, Any]:
         raise RpcError(f"frame too large: {body_len}")
     body = await _read_exact(reader, body_len)
     buffers = []
+    nbytes = _HEADER.size + 8 + body_len
     for _ in range(n_oob):
         (blen,) = struct.unpack(">Q", await _read_exact(reader, 8))
         if blen > MAX_FRAME:
             raise RpcError(f"oob buffer too large: {blen}")
         buffers.append(await _read_exact(reader, blen))
+        nbytes += 8 + blen
+    if _fr._ENABLED:
+        _fr.wire_rx(kind, "async", nbytes)
     return kind, msg_id, _loads(body, buffers)
 
 
 def send_frame_blocking(sock, kind: int, msg_id: int, obj: Any) -> None:
     """Blocking-socket counterpart of _write_frame (fast-lane threads)."""
-    sock.sendall(b"".join(_frame_parts(kind, msg_id, obj)))
+    sock.sendall(b"".join(_frame_parts(kind, msg_id, obj, lane="fast")))
 
 
 def recv_frame_blocking(sock) -> Tuple[int, int, Any]:
@@ -154,11 +198,15 @@ def recv_frame_blocking(sock) -> Tuple[int, int, Any]:
         raise RpcError(f"frame too large: {body_len}")
     body = recv_exact(body_len)
     buffers = []
+    nbytes = _HEADER.size + 8 + body_len
     for _ in range(n_oob):
         (blen,) = struct.unpack(">Q", recv_exact(8))
         if blen > MAX_FRAME:
             raise RpcError(f"oob buffer too large: {blen}")
         buffers.append(recv_exact(blen))
+        nbytes += 8 + blen
+    if _fr._ENABLED:
+        _fr.wire_rx(kind, "fast", nbytes)
     return kind, msg_id, _loads(body, buffers)
 
 
@@ -395,9 +443,14 @@ class RpcClient:
                 self._writer = None
                 self._fail_all(ConnectionLost(f"connection to {self.name} lost"))
 
-    async def start_call(self, method: str, **kwargs) -> "asyncio.Future":
+    async def start_call(self, method: str, fr_rec: Optional[dict] = None,
+                         **kwargs) -> "asyncio.Future":
         """Write the request and return the reply future without awaiting it —
-        lets a caller pipeline ordered requests (actor submitter)."""
+        lets a caller pipeline ordered requests (actor submitter).
+
+        ``fr_rec``: sampled flight-recorder call record — when given, the
+        serialize/frame-build/syscall stamps land in it (the caller owns
+        closing the record when the reply is handled)."""
         if self._chaos.enabled:
             self._chaos.maybe_fail(method, exc_type=ConnectionLost)
             await self._chaos.inject_delay(method)
@@ -424,14 +477,15 @@ class RpcClient:
             # and no write lock is needed. Backpressure: the transport
             # buffers; large-payload callers should prefer notify/drain.
             _write_frame_sync(self._writer, KIND_REQUEST, msg_id,
-                              (method, kwargs))
+                              (method, kwargs), rec=fr_rec)
         except (ConnectionResetError, BrokenPipeError, AttributeError, OSError) as e:
             self._pending.pop(msg_id, None)
             raise ConnectionLost(str(e)) from e
         return fut
 
-    async def call(self, method: str, timeout: Optional[float] = None, **kwargs) -> Any:
-        fut = await self.start_call(method, **kwargs)
+    async def call(self, method: str, timeout: Optional[float] = None,
+                   fr_rec: Optional[dict] = None, **kwargs) -> Any:
+        fut = await self.start_call(method, fr_rec=fr_rec, **kwargs)
         if timeout is None:
             timeout = get_config().gcs_rpc_timeout_s
         # Manual timer instead of asyncio.wait_for/timeout: one call_later
@@ -533,6 +587,12 @@ class EventLoopThread:
         self.loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
+        try:
+            # Lag sampler arms via call_soon_threadsafe, so attaching
+            # right after start is safe even before run_forever spins up.
+            _fr.attach_loop(self.loop, name)
+        except Exception:  # noqa: BLE001 - observability must not block io
+            pass
 
     def _run(self) -> None:
         asyncio.set_event_loop(self.loop)

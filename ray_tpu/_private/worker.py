@@ -25,6 +25,7 @@ import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import backoff as backoff_mod
+from ray_tpu._private import flight_recorder as _fr
 from ray_tpu._private import serialization as ser
 from ray_tpu._private.function_manager import FunctionManager
 from ray_tpu._private.ids import (
@@ -558,14 +559,30 @@ class ActorSubmitter:
                 # pinning receive frames beyond task execution — switching
                 # them to objects leaked a device-object borrow in the
                 # channel-DAG suite.
+                _fr.note_batch("actor", len(batch))
+                # Sampled flight-recorder decomposition: ser_spec time folds
+                # into the serialize phase; start_call stamps frame/syscall.
+                rec = _fr.maybe_begin_call(batch[0][0].function_name)
                 if len(batch) == 1:
                     spec, retries, attempt = batch[0]
+                    if rec is None:
+                        payload = ser_spec(spec)
+                    else:
+                        t = time.perf_counter_ns()
+                        payload = ser_spec(spec)
+                        rec["pre_serialize_ns"] = time.perf_counter_ns() - t
                     fut = await client.start_call("push_actor_task",
-                                                  spec=ser_spec(spec))
+                                                  fr_rec=rec, spec=payload)
                 else:
+                    if rec is None:
+                        payloads = [ser_spec(s) for s, _, _ in batch]
+                    else:
+                        t = time.perf_counter_ns()
+                        payloads = [ser_spec(s) for s, _, _ in batch]
+                        rec["pre_serialize_ns"] = time.perf_counter_ns() - t
                     fut = await client.start_call(
-                        "push_actor_task_batch",
-                        specs=[ser_spec(s) for s, _, _ in batch])
+                        "push_actor_task_batch", fr_rec=rec,
+                        specs=payloads)
             except (ConnectionLost, asyncio.TimeoutError) as e:
                 for spec, retries, attempt in batch:
                     await self._on_send_failure(spec, retries, attempt, e)
@@ -578,13 +595,15 @@ class ActorSubmitter:
             if len(batch) == 1:
                 spec, retries, attempt = batch[0]
                 fut.add_done_callback(
-                    lambda f, s=spec, r=retries, a=attempt:
-                    self._on_reply_done(s, r, a, f))
+                    lambda f, s=spec, r=retries, a=attempt, rc=rec:
+                    self._on_reply_done(s, r, a, f, rc))
             else:
-                asyncio.ensure_future(self._handle_batch_reply(batch, fut))
+                asyncio.ensure_future(
+                    self._handle_batch_reply(batch, fut, rec))
 
     def _on_reply_done(self, spec: TaskSpec, retries: int, attempt: int,
-                       fut: "asyncio.Future") -> None:
+                       fut: "asyncio.Future", rec: Optional[dict] = None
+                       ) -> None:
         """Done-callback reply path: the overwhelmingly common reply (ok,
         inline/shm results, no borrows) completes synchronously with no Task
         creation; anything else falls back to the async handler."""
@@ -593,12 +612,20 @@ class ActorSubmitter:
                 self._handle_reply(spec, retries, attempt, fut))
             return
         reply = fut.result()
-        if self.worker.handle_task_reply_fast(spec, reply):
+        if rec is not None:
+            t0 = time.perf_counter_ns()
+            handled = self.worker.handle_task_reply_fast(spec, reply)
+            _fr.finish_call_from_reply(
+                rec, reply, time.perf_counter_ns() - t0)
+            if handled:
+                return
+        elif self.worker.handle_task_reply_fast(spec, reply):
             return
         asyncio.ensure_future(
             self._handle_reply(spec, retries, attempt, fut))
 
-    async def _handle_batch_reply(self, batch, fut: "asyncio.Future") -> None:
+    async def _handle_batch_reply(self, batch, fut: "asyncio.Future",
+                                  rec: Optional[dict] = None) -> None:
         try:
             reply = await asyncio.wait_for(fut, 86400.0)
         except (ConnectionLost, RemoteError, asyncio.TimeoutError) as e:
@@ -607,8 +634,12 @@ class ActorSubmitter:
             if self._pump_task is None or self._pump_task.done():
                 self._pump_task = asyncio.ensure_future(self._pump())
             return
+        t0 = time.perf_counter_ns() if rec is not None else 0
         for (spec, _, _), item in zip(batch, reply["replies"]):
             await self.worker.handle_task_reply(spec, item)
+        if rec is not None:
+            _fr.finish_call_from_reply(
+                rec, reply, time.perf_counter_ns() - t0)
 
     async def _on_send_failure(self, spec: TaskSpec, retries: int,
                                attempt: int, exc: BaseException) -> None:
@@ -973,6 +1004,8 @@ class Worker:
         s.register("dump_stacks", self._rpc_dump_stacks)
         s.register("cpu_profile", self._rpc_cpu_profile)
         s.register("heap_profile", self._rpc_heap_profile)
+        s.register("overhead_breakdown", self._rpc_overhead_breakdown)
+        s.register("flight_record", self._rpc_flight_record)
         s.register("device_object_fetch", self._rpc_device_object_fetch)
         s.register("device_object_fetch_shm", self._rpc_device_object_fetch_shm)
         s.register("device_object_mesh_send", self._rpc_device_object_mesh_send)
@@ -1017,6 +1050,17 @@ class Worker:
 
         return await asyncio.get_running_loop().run_in_executor(
             None, profiler.heap_snapshot, duration, top)
+
+    async def _rpc_overhead_breakdown(self) -> Dict[str, Any]:
+        """Sampled per-call overhead decomposition of calls THIS process
+        issued (workers are submitters too: actor-to-actor calls, borrowed
+        refs) — fanned cluster-wide by the nodelet."""
+        return _fr.overhead_breakdown()
+
+    async def _rpc_flight_record(self) -> Dict[str, Any]:
+        """Flight-recorder ring dump + wire/loop summaries for this
+        process."""
+        return _fr.flight_snapshot()
 
     async def _rpc_dag_channel_push(self, key: str, payload) -> Dict[str, Any]:
         from ray_tpu.experimental.channel import rpc_channel
@@ -2083,10 +2127,12 @@ class Worker:
         for spec in specs:
             spec.lease_ts = now  # LEASE_GRANTED: a leased worker took it
             self.task_manager.mark_inflight(spec.task_id, addr)
+        _fr.note_batch("task", len(specs))
+        rec = _fr.maybe_begin_call(specs[0].function_name)
         try:
             reply = await client.call(
                 "push_task_batch", specs=specs,
-                timeout=86400.0)
+                timeout=86400.0, fr_rec=rec)
             replies = reply["replies"]
         except (ConnectionLost, RemoteError, asyncio.TimeoutError, OSError) as e:
             for spec in specs:
@@ -2108,8 +2154,12 @@ class Worker:
                 self.task_manager.fail_permanently(
                     spec.task_id, ser.serialize_error(e))
             return True
+        t0 = time.perf_counter_ns() if rec is not None else 0
         for spec, item in zip(specs, replies):
             await self.handle_task_reply(spec, item)
+        if rec is not None:
+            _fr.finish_call_from_reply(
+                rec, reply, time.perf_counter_ns() - t0)
         return True
 
     async def push_task_to(self, client: RpcClient, addr: Tuple[str, int],
@@ -2118,9 +2168,10 @@ class Worker:
         unusable (connection lost) so the caller drops the lease."""
         spec.lease_ts = time.time()  # LEASE_GRANTED: a leased worker took it
         self.task_manager.mark_inflight(spec.task_id, addr)
+        rec = _fr.maybe_begin_call(spec.function_name)
         try:
             reply = await client.call("push_task", spec=spec,
-                                      timeout=86400.0)
+                                      timeout=86400.0, fr_rec=rec)
         except (ConnectionLost, RemoteError, asyncio.TimeoutError, OSError) as e:
             retry_spec = self.task_manager.fail_or_retry(spec.task_id)
             if retry_spec is not None:
@@ -2142,7 +2193,11 @@ class Worker:
             self.task_manager.fail_permanently(
                 spec.task_id, ser.serialize_error(e))
             return True
+        t0 = time.perf_counter_ns() if rec is not None else 0
         await self.handle_task_reply(spec, reply)
+        if rec is not None:
+            _fr.finish_call_from_reply(
+                rec, reply, time.perf_counter_ns() - t0)
         return True
 
     def handle_task_reply_fast(self, spec: TaskSpec,
@@ -2411,11 +2466,17 @@ class Worker:
     # Execution side (runs in worker processes)
     # ------------------------------------------------------------------
     async def _rpc_push_task(self, spec) -> Dict[str, Any]:
+        t_entry = time.perf_counter_ns() if _fr._ENABLED else 0
         if isinstance(spec, (bytes, bytearray, memoryview)):
             spec = deser_spec(spec)
         loop = asyncio.get_running_loop()
-        return await loop.run_in_executor(
+        reply = await loop.run_in_executor(
             self._task_executor, self._execute_task_sync, spec)
+        if t_entry and isinstance(reply, dict):
+            # Server-total stamp (_frs): receipt -> reply ready. The client
+            # stitches dispatch = _frs - exec into its sampled record.
+            reply["_frs"] = time.perf_counter_ns() - t_entry
+        return reply
 
     async def _rpc_push_task_batch(self, specs: List[TaskSpec]) -> Dict[str, Any]:
         """Execute a batch of normal tasks (one RPC frame per submitter
@@ -2430,8 +2491,12 @@ class Worker:
                 deser_spec(s) if isinstance(s, bytes) else s)
                 for s in specs]
 
+        t_entry = time.perf_counter_ns() if _fr._ENABLED else 0
         replies = await loop.run_in_executor(self._task_executor, run_batch)
-        return {"replies": replies}
+        out: Dict[str, Any] = {"replies": replies}
+        if t_entry:
+            out["_frs"] = time.perf_counter_ns() - t_entry
+        return out
 
     async def _rpc_create_actor(self, creation_spec: bytes) -> Dict[str, Any]:
         spec = deser_spec(creation_spec)
@@ -2510,6 +2575,7 @@ class Worker:
         try:
             while not self._shutdown:
                 kind, msg_id, (method, kwargs) = recv_frame_blocking(conn)
+                t_entry = time.perf_counter_ns() if _fr._ENABLED else 0
                 try:
                     if method == "push_actor_task":
                         reply = self._fast_lane_execute(kwargs["spec"])
@@ -2522,6 +2588,8 @@ class Worker:
                     else:
                         raise RuntimeError(
                             f"method {method!r} not supported on fast lane")
+                    if t_entry and isinstance(reply, dict):
+                        reply["_frs"] = time.perf_counter_ns() - t_entry
                     send_frame_blocking(conn, KIND_RESPONSE, msg_id,
                                         (True, reply))
                 except BaseException as e:  # noqa: BLE001
@@ -2641,6 +2709,7 @@ class Worker:
         """Execute a batch of actor tasks. Runs of consecutive sync methods
         collapse into one executor hop (ordering preserved — same thread, in
         order); async methods interleave via gather as before."""
+        t_entry = time.perf_counter_ns() if _fr._ENABLED else 0
         decoded = [deser_spec(s) if isinstance(s, bytes) else s
                    for s in specs]
         loop = asyncio.get_running_loop()
@@ -2693,9 +2762,13 @@ class Worker:
                 replies.append(res)
             else:
                 replies.extend(res)
-        return {"replies": replies}
+        out: Dict[str, Any] = {"replies": replies}
+        if t_entry:
+            out["_frs"] = time.perf_counter_ns() - t_entry
+        return out
 
     async def _rpc_push_actor_task(self, spec: TaskSpec) -> Dict[str, Any]:
+        t_entry = time.perf_counter_ns() if _fr._ENABLED else 0
         if os.environ.get("RAY_TPU_PUSH_TRACE"):
             t0 = time.perf_counter_ns()
             if isinstance(spec, (bytes, bytearray, memoryview)):
@@ -2704,10 +2777,15 @@ class Worker:
             reply = await self._rpc_push_actor_task_decoded(spec)
             t2 = time.perf_counter_ns()
             reply["_trace"] = {"entry": t0, "decoded": t1, "done": t2}
+            if t_entry:
+                reply["_frs"] = time.perf_counter_ns() - t_entry
             return reply
         if isinstance(spec, (bytes, bytearray, memoryview)):
             spec = deser_spec(spec)
-        return await self._rpc_push_actor_task_decoded(spec)
+        reply = await self._rpc_push_actor_task_decoded(spec)
+        if t_entry and isinstance(reply, dict):
+            reply["_frs"] = time.perf_counter_ns() - t_entry
+        return reply
 
     async def _rpc_push_actor_task_decoded(
             self, task_spec: TaskSpec) -> Dict[str, Any]:
@@ -2793,10 +2871,17 @@ class Worker:
             args, kwargs = self._resolve_spec_args_sync(spec)
             args_ready_ts = time.time()
             self._current_task_id = spec.task_id
+            t_exec = time.perf_counter_ns() if _fr._ENABLED else 0
             result = method(*args, **kwargs)
+            t_done = time.perf_counter_ns() if t_exec else 0
             if spec.num_returns == -1:
                 return self._stream_generator(spec, iter(result))
             reply = self._reply_results(spec, result)
+            if t_exec:
+                # Exec-only stamp (_frx): user code, excluding arg
+                # resolution (charged to dispatch) and result packing.
+                reply["_frx"] = t_done - t_exec
+                _fr.note_exec(spec.function_name, t_done - t_exec)
             if texec:
                 reply["_trace_exec"] = {
                     "exec_start": texec, "exec_end": time.perf_counter_ns()}
@@ -2822,10 +2907,16 @@ class Worker:
             args, kwargs = self._resolve_spec_args_sync(spec)
             args_ready_ts = time.time()
             self._current_task_id = spec.task_id
+            t_exec = time.perf_counter_ns() if _fr._ENABLED else 0
             result = fn(*args, **kwargs)
+            t_done = time.perf_counter_ns() if t_exec else 0
             if spec.num_returns == -1:
                 return self._stream_generator(spec, iter(result))
-            return self._reply_results(spec, result)
+            reply = self._reply_results(spec, result)
+            if t_exec:
+                reply["_frx"] = t_done - t_exec
+                _fr.note_exec(spec.function_name, t_done - t_exec)
+            return reply
         except BaseException as e:  # noqa: BLE001
             ok = False
             logger.info("task %s raised: %r", spec.function_name, e)

@@ -105,6 +105,67 @@ def broadcast_bench(ray_tpu, cluster, *, n_nodes: int = 4,
             "baseline": 12.6, "baseline_note": "1 GiB to 50 nodes"}
 
 
+# ---------------------------------------------------------------------------
+# Measured zero-framework ceilings for the scale rows (same idea as
+# micro_bench.measure_host_ceilings): the raw-host rate for the same SHAPE
+# of work, recorded beside each row so the envelope gap is attributable —
+# "X% of what fork+pipe alone could do on this box", not a bare number.
+# ---------------------------------------------------------------------------
+def _boot_child(conn):
+    conn.send(b"up")
+    conn.recv()
+
+
+def _ceiling_fork_boot(n: int = 60, window: int = 10) -> float:
+    """Fork + interpreter-warm child + one pipe round-trip + join, in
+    rolling windows — the zero-framework floor of the many_actors
+    create/ping/destroy cycle (worker spawn dominates actor churn)."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("fork")
+    t0 = time.perf_counter()
+    made = 0
+    while made < n:
+        k = min(window, n - made)
+        pairs = [ctx.Pipe() for _ in range(k)]
+        procs = [ctx.Process(target=_boot_child, args=(child,), daemon=True)
+                 for _, child in pairs]
+        for p in procs:
+            p.start()
+        for parent, _ in pairs:
+            parent.recv()
+            parent.send(b"die")
+        for p in procs:
+            p.join(timeout=10)
+        made += k
+    return n / (time.perf_counter() - t0)
+
+
+def measure_scale_ceilings(n_procs: int = 4) -> Dict[str, Dict[str, Any]]:
+    """Per-row {ceiling_value, ceiling_method}, keyed like the suite."""
+    from ray_tpu.benchmarks.micro_bench import _ceiling_n_proc_echo
+
+    boot = _ceiling_fork_boot()
+    echo = _ceiling_n_proc_echo(n_procs, 250)
+    return {
+        "many_actors": {
+            "ceiling_value": round(boot, 1),
+            "ceiling_method": "fork + child boot + pipe round-trip + "
+                              "join, windows of 10 (worker spawn floor)"},
+        "many_tasks": {
+            "ceiling_value": round(echo, 1),
+            "ceiling_method": f"{n_procs}-process pipe echo, pipelined "
+                              "(drain-rate floor)"},
+        "many_pgs": {
+            # pg create + ready + remove is three serialized GCS
+            # round-trips; the raw-host analogue is a third of the
+            # pipelined echo rate.
+            "ceiling_value": round(echo / 3, 1),
+            "ceiling_method": f"{n_procs}-process pipe echo / 3 "
+                              "(create+ready+remove = 3 round-trips)"},
+    }
+
+
 def run_scale_suite(ray_tpu, cluster=None,
                     progress=None) -> Dict[str, Any]:
     # The arena's background prefault (~11 µs/page here) must not bleed
@@ -116,10 +177,15 @@ def run_scale_suite(ray_tpu, cluster=None,
     except Exception:
         pass
     out: Dict[str, Any] = {}
+    try:
+        ceilings = measure_scale_ceilings()
+    except Exception:  # noqa: BLE001
+        ceilings = {}
     for name, fn in (("many_actors", many_actors_bench),
                      ("many_tasks", many_tasks_bench),
                      ("many_pgs", many_pgs_bench)):
         out[name] = fn(ray_tpu)
+        out[name].update(ceilings.get(name, {}))
         if progress:
             progress(f"{name}: {out[name]}")
     if cluster is not None:

@@ -369,6 +369,12 @@ class GcsServer:
         um.set_flush_sink(lambda key, payload: loop.call_soon_threadsafe(
             self._metrics_kv_put, key, payload))
         self._background.append(asyncio.ensure_future(self._metrics_loop()))
+        # Flight recorder: lag-sample the GCS loop — a stalled GCS loop
+        # delays every heartbeat/lease in the cluster, exactly the stall
+        # the sampler exists to attribute.
+        from ray_tpu._private import flight_recorder as _fr
+
+        _fr.attach_loop(loop, "gcs")
         logger.info("GCS listening on %s:%d", *addr)
         return addr
 

@@ -295,6 +295,11 @@ class Nodelet:
 
         um.set_flush_sink(_metrics_sink)
         self._background.append(asyncio.ensure_future(self._metrics_loop()))
+        # Flight recorder: lag-sample this loop (worker loops attach in
+        # EventLoopThread; the nodelet runs under asyncio.run).
+        from ray_tpu._private import flight_recorder as _fr
+
+        _fr.attach_loop(loop, "nodelet")
         logger.info("nodelet %s on %s:%d resources=%s", self.node_name, *addr,
                     self.resources_total)
         return addr
@@ -1086,6 +1091,20 @@ class Nodelet:
         """All-thread python stacks for every live worker on this node,
         gathered concurrently (the `ray stack` surface)."""
         return await self._fanout_workers("dump_stacks")
+
+    async def rpc_node_overhead(self) -> Dict[str, Any]:
+        """Sampled per-call overhead decomposition from every live worker
+        on this node (flight recorder; `ray_tpu profile --overhead`)."""
+        return await self._fanout_workers("overhead_breakdown")
+
+    async def rpc_node_flight_record(self) -> Dict[str, Any]:
+        """Flight-recorder ring dumps: every live worker's, plus this
+        nodelet's own (`ray_tpu debug flight-record`)."""
+        from ray_tpu._private import flight_recorder as _fr
+
+        out = await self._fanout_workers("flight_record")
+        out["nodelet"] = _fr.flight_snapshot()
+        return out
 
     async def rpc_profile_workers(self, kind: str = "cpu",
                                   duration: float = 5.0,

@@ -19,6 +19,7 @@ import ctypes
 import os
 import struct
 import threading
+import time
 from typing import Dict, List, Optional
 
 from ray_tpu._private.ids import ObjectID
@@ -58,6 +59,34 @@ def _spilled_bytes_counter():
 
     return um.get_counter("ray_tpu_object_store_spilled_bytes_total",
                           "Bytes spilled from the arena to disk")
+
+
+_PHASE_BOUNDARIES = (0.00001, 0.0001, 0.0005, 0.001, 0.005, 0.01,
+                     0.05, 0.1, 0.5, 1.0)
+
+
+def _put_phase_histogram():
+    """Flight-recorder phase decomposition for large puts: alloc (arena
+    reservation) / memcpy / seal — the profile the red
+    `single_client_put_gigabytes` row needs."""
+    from ray_tpu.util import metrics as um
+
+    return um.get_histogram(
+        "ray_tpu_object_store_put_phase_seconds",
+        "Shared-memory put phases (alloc|memcpy|seal)",
+        boundaries=_PHASE_BOUNDARIES, tag_keys=("phase",))
+
+
+def _get_phase_histogram():
+    """Per-ref get decomposition: lookup (index probe) / anchor (numpy
+    view + release finalizer) / parse (header+buffer walk) — the per-ref
+    cost profile behind `get_object_containing_10k_refs`."""
+    from ray_tpu.util import metrics as um
+
+    return um.get_histogram(
+        "ray_tpu_object_store_get_phase_seconds",
+        "Shared-memory get phases (lookup|anchor|parse)",
+        boundaries=_PHASE_BOUNDARIES, tag_keys=("phase",))
 
 
 def _load_native():
@@ -166,8 +195,17 @@ class SharedMemoryStore:
     # -- raw bytes API --
 
     def put_raw(self, object_id: ObjectID, payload_parts: List[bytes]) -> bool:
-        """Write an object as concatenated parts. False if it already exists."""
+        """Write an object as concatenated parts. False if it already exists.
+
+        Flight-recorder phase stamps (alloc/memcpy/seal) are always-on for
+        puts ≥1 MiB (3 perf_counter calls are noise against a memcpy that
+        size) and sampled 1-in-N below it."""
+        from ray_tpu._private import flight_recorder as _fr
+
         total = sum(len(p) for p in payload_parts)
+        timed = _fr.enabled() and (total >= 1 << 20
+                                   or _fr.maybe_sample())
+        t0 = time.perf_counter() if timed else 0.0
         off = ctypes.c_uint64()
         rc = self._lib.shm_store_create_object(
             self._handle, object_id.binary(), total, ctypes.byref(off)
@@ -182,6 +220,7 @@ class SharedMemoryStore:
         if rc != SHM_OK:
             raise OSError(f"shm create failed rc={rc}")
         _arena_puts_counter().inc(tags={"result": "hit"})
+        t1 = time.perf_counter() if timed else 0.0
         try:
             pos = off.value
             for part in payload_parts:
@@ -203,8 +242,24 @@ class SharedMemoryStore:
         except BaseException:
             self._lib.shm_store_abort(self._handle, object_id.binary())
             raise
+        t2 = time.perf_counter() if timed else 0.0
         self._lib.shm_store_seal(self._handle, object_id.binary())
         self._lib.shm_store_release(self._handle, object_id.binary())
+        if timed:
+            t3 = time.perf_counter()
+            h = _put_phase_histogram()
+            h.observe(t1 - t0, tags={"phase": "alloc"})
+            h.observe(t2 - t1, tags={"phase": "memcpy"})
+            h.observe(t3 - t2, tags={"phase": "seal"})
+            if total >= 8 * 1024 * 1024:
+                _fr.record_event(
+                    "store_put", nbytes=total,
+                    total_us=round((t3 - t0) * 1e6, 1),
+                    alloc_us=round((t1 - t0) * 1e6, 1),
+                    memcpy_us=round((t2 - t1) * 1e6, 1),
+                    seal_us=round((t3 - t2) * 1e6, 1),
+                    gib_per_s=round(
+                        total / max(t2 - t1, 1e-9) / (1 << 30), 2))
         return True
 
     def get_raw(self, object_id: ObjectID) -> Optional[memoryview]:
@@ -256,9 +311,16 @@ class SharedMemoryStore:
         top of them) is garbage-collected, the pin is released and the object
         becomes evictable — the plasma client's Buffer-release semantics
         (reference: plasma/client.h Release on buffer destruction)."""
+        from ray_tpu._private import flight_recorder as _fr
+
+        # Sampled phase stamps only: ref-heavy gets run this per ref
+        # (10k-ref benches), so even cheap stamps must not be per-op.
+        timed = _fr.enabled() and _fr.maybe_sample()
+        t0 = time.perf_counter() if timed else 0.0
         view = self.get_raw(object_id)
         if view is None:
             return None
+        t1 = time.perf_counter() if timed else 0.0
         import weakref
 
         import numpy as np
@@ -268,6 +330,7 @@ class SharedMemoryStore:
         anchor = np.frombuffer(view, dtype=np.uint8)
         weakref.finalize(anchor, self.release, object_id)
         avm = memoryview(anchor)
+        t2 = time.perf_counter() if timed else 0.0
         (mlen,) = struct.unpack(">I", view[:4])
         metadata = bytes(view[4 : 4 + mlen])
         pos = 4 + mlen
@@ -279,6 +342,11 @@ class SharedMemoryStore:
             pos += 8
             buffers.append(avm[pos : pos + blen])
             pos += blen
+        if timed:
+            h = _get_phase_histogram()
+            h.observe(t1 - t0, tags={"phase": "lookup"})
+            h.observe(t2 - t1, tags={"phase": "anchor"})
+            h.observe(time.perf_counter() - t2, tags={"phase": "parse"})
         return SerializedObject(metadata, buffers, [])  # type: ignore[arg-type]
 
     def stats(self) -> Dict[str, int]:

@@ -12,14 +12,20 @@ serve proxy). Endpoints:
   /api/placement_groups   list_placement_groups()
   /api/tasks              list_task_events
   /api/tasks/breakdown    task_latency_breakdown()
+  /api/profile/overhead   overhead_breakdown()  (flight recorder)
+  /api/flight_record      flight_record()       (ring dump)
   /metrics                Prometheus text exposition
   /healthz
+
+All 200 responses carry an ETag; requests with a matching If-None-Match
+get a body-less 304 so the polling UI can skip re-rendering.
 """
 
 from __future__ import annotations
 
 import asyncio
 import json
+import zlib
 from typing import Any, Optional
 
 import ray_tpu
@@ -52,16 +58,32 @@ class DashboardActor:
                 _, path, _ = line.decode().split(" ", 2)
             except ValueError:
                 return
+            if_none_match = ""
             while True:
                 h = await reader.readline()
                 if h in (b"\r\n", b"", b"\n"):
                     break
+                if h.lower().startswith(b"if-none-match:"):
+                    if_none_match = h.split(b":", 1)[1].strip().decode(
+                        "latin-1")
             out = await self._route(path)
             status, body = out[0], out[1]
             ctype = out[2] if len(out) > 2 else "application/json"
+            extra = b""
+            if status == 200:
+                # Conditional GET: the UI polls every 2s but most payloads
+                # only change occasionally — a matching If-None-Match gets
+                # an empty 304 so the browser reuses its cached body and
+                # the page skips the re-render.
+                etag = '"%08x-%x"' % (zlib.crc32(body) & 0xFFFFFFFF,
+                                      len(body))
+                if etag in [t.strip().lstrip("W/")
+                            for t in if_none_match.split(",")]:
+                    status, body = 304, b""
+                extra = b"etag: " + etag.encode() + b"\r\n"
             writer.write(
                 b"HTTP/1.1 " + str(status).encode() + b" X\r\n"
-                b"content-type: " + ctype.encode() + b"\r\n"
+                b"content-type: " + ctype.encode() + b"\r\n" + extra +
                 b"content-length: " + str(len(body)).encode() +
                 b"\r\nconnection: close\r\n\r\n" + body)
             await writer.drain()
@@ -152,6 +174,10 @@ class DashboardActor:
             # reporter/ — stack dumps + process stats per node).
             "/api/stacks": state.stack_dump,
             "/api/proc_stats": state.node_proc_stats,
+            # Flight recorder surfaces: per-call overhead budget and the
+            # raw ring dump (wire counters, loop lag, recent events).
+            "/api/profile/overhead": state.overhead_breakdown,
+            "/api/flight_record": state.flight_record,
         }
         fn = table.get(path.rstrip("/"))
         if fn is None:

@@ -11,7 +11,7 @@ Cluster lifecycle:
 
 State queries (need --address):
     status | nodes | actors | workers | jobs | placement-groups | tasks |
-    timeline | memory | metrics
+    timeline | memory | metrics | stack | proc-stats | profile | debug
 
 `start` records the running cluster in /tmp/ray_tpu/current_cluster.json
 (reference: /tmp/ray/ray_current_cluster) so `stop` and address-less
@@ -236,6 +236,35 @@ def _state_command(args) -> None:
             out = state.stack_dump()
         elif args.command == "proc-stats":
             out = state.node_proc_stats()
+        elif args.command == "profile":
+            if getattr(args, "overhead", False):
+                out = state.overhead_breakdown()
+            else:
+                out = state.cpu_profile(duration=args.duration)
+        elif args.command == "debug":
+            if args.what != "flight-record":
+                sys.exit(f"unknown debug target {args.what!r} "
+                         "(expected: flight-record)")
+            out = state.flight_record()
+            if getattr(args, "trace", ""):
+                from ray_tpu._private import flight_recorder as fr_mod
+
+                events = []
+                events += fr_mod.chrome_trace_events(
+                    out["driver"].get("events", []), pid="driver-flight")
+                for pid, snap in (out.get("drivers") or {}).items():
+                    if isinstance(snap, dict):
+                        events += fr_mod.chrome_trace_events(
+                            snap.get("events") or [], pid=f"driver-{pid}")
+                for node, reply in (out.get("nodes") or {}).items():
+                    for wid, snap in (reply.get("workers") or {}).items():
+                        if isinstance(snap, dict):
+                            events += fr_mod.chrome_trace_events(
+                                snap.get("events", []),
+                                pid=f"{node}/{wid}")
+                with open(args.trace, "w") as f:
+                    json.dump(events, f)
+                out = {"written": args.trace, "events": len(events)}
         else:
             out = state.list_placement_groups()
         json.dump(out, sys.stdout, indent=2, default=_jsonable)
@@ -296,6 +325,30 @@ def main() -> None:
                                 "(queue/lease/fetch/exec p50/p95/max "
                                 "per function) instead of the raw list")
         p.set_defaults(fn=_state_command)
+
+    p = sub.add_parser("profile",
+                       help="cluster-wide CPU profile, or per-call "
+                            "overhead decomposition with --overhead")
+    p.add_argument("--address")
+    p.add_argument("--overhead", action="store_true",
+                   help="report the flight recorder's per-function "
+                        "overhead budget (serialize/frame/syscall/"
+                        "dispatch/exec/reply/wire, in microseconds)")
+    p.add_argument("--duration", type=float, default=5.0,
+                   help="sampling window for the CPU profile (seconds)")
+    p.set_defaults(fn=_state_command)
+
+    p = sub.add_parser("debug",
+                       help="low-level debug dumps (flight-record)")
+    p.add_argument("what", choices=["flight-record"],
+                   help="flight-record: dump the in-memory flight "
+                        "recorder ring from driver and workers")
+    p.add_argument("--address")
+    p.add_argument("--trace", default="",
+                   help="also write a Chrome-trace JSON of the ring "
+                        "events to this path (load via chrome://tracing "
+                        "or Perfetto)")
+    p.set_defaults(fn=_state_command)
 
     args = parser.parse_args()
     if getattr(args, "global_address", None) and not getattr(

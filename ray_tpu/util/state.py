@@ -164,10 +164,14 @@ def timeline(path: Optional[str] = None) -> Any:
     `ray timeline`, scripts.py:2689). Events missing the required fields
     (a crashed reporter, a partial flush) are skipped, not fatal; the
     parent span id rides along in args so driver spans, task rows, and
-    runtime phase spans read as one connected trace."""
+    runtime phase spans read as one connected trace. The driver's
+    flight-recorder ring (sampled call decompositions, loop stalls, large
+    store puts) merges in under cat=FLIGHT."""
     import json
 
-    events = []
+    from ray_tpu._private import flight_recorder as _fr
+
+    events = _fr.chrome_trace_events(pid="driver-flight")
     for ev in list_tasks(limit=20_000):
         name = ev.get("name")
         start = ev.get("start_ts")
@@ -192,6 +196,76 @@ def timeline(path: Optional[str] = None) -> Any:
     with open(path, "w") as f:
         json.dump(events, f)
     return path
+
+
+def overhead_breakdown(cluster: bool = True) -> Dict[str, Any]:
+    """Per-function µs overhead decomposition of sampled calls (flight
+    recorder): serialize/frame/syscall/dispatch/exec/reply plus the
+    measured wire remainder, each with count/mean/p50/p95/max. The phases
+    telescope — per function, the phase means sum to the e2e mean
+    (`coverage` ≈ 1.0). "driver" covers calls this process issued;
+    "nodes" fans out to every worker (workers submit too: actor-to-actor
+    calls, lease pushes)."""
+    from ray_tpu._private import flight_recorder as _fr
+
+    out: Dict[str, Any] = {"driver": _fr.overhead_breakdown()}
+    if cluster:
+        try:
+            out["nodes"] = _collect_per_node("node_overhead", timeout=15)
+        except Exception:  # noqa: BLE001 - local view still useful
+            out["nodes"] = {}
+        out["drivers"] = {pid: snap.get("breakdown", {})
+                          for pid, snap in _driver_kv_snapshots().items()}
+    return out
+
+
+def flight_record(cluster: bool = True) -> Dict[str, Any]:
+    """Flight-recorder ring dump + wire/loop-lag summaries: the driver's
+    own, plus (cluster=True) every nodelet's and worker's."""
+    from ray_tpu._private import flight_recorder as _fr
+
+    out: Dict[str, Any] = {"driver": _fr.flight_snapshot()}
+    if cluster:
+        try:
+            out["nodes"] = _collect_per_node("node_flight_record",
+                                             timeout=15)
+        except Exception:  # noqa: BLE001
+            out["nodes"] = {}
+        out["drivers"] = {
+            pid: {k: snap.get(k) for k in ("wire", "loops", "events")}
+            for pid, snap in _driver_kv_snapshots().items()}
+    return out
+
+
+def _driver_kv_snapshots(include_self: bool = False) -> Dict[str, Any]:
+    """Flight-recorder snapshots other driver processes parked in GCS KV
+    (their publisher exports every ~2s; drivers cannot be RPC'd into).
+    Entries older than the freshness window are exited drivers — skipped,
+    and so is this process (its live ring is already the "driver" key)."""
+    import json
+    import os
+    import time as _time
+
+    from ray_tpu._private import flight_recorder as _fr
+    from ray_tpu._private import worker as worker_mod
+
+    out: Dict[str, Any] = {}
+    try:
+        w = worker_mod.global_worker()
+        for key in w._gcs_call_sync("kv_keys", prefix=_fr.KV_PREFIX):
+            raw = w._gcs_call_sync("kv_get", key=key)
+            if not raw:
+                continue
+            snap = json.loads(raw)
+            pid = str(snap.get("pid", key[len(_fr.KV_PREFIX):]))
+            if not include_self and snap.get("pid") == os.getpid():
+                continue
+            if _time.time() - float(snap.get("ts", 0)) > _fr.KV_FRESH_S:
+                continue
+            out[pid] = snap
+    except Exception:  # noqa: BLE001 - cross-driver view is best-effort
+        pass
+    return out
 
 
 def _latency_summary(vals: List[float]) -> Dict[str, float]:

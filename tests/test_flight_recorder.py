@@ -1,0 +1,422 @@
+"""Flight recorder (ray_tpu/_private/flight_recorder.py): per-call
+overhead decomposition math, wire accounting through the real frame
+builder, the event-loop lag sampler/stall watchdog, the metric
+publisher, chrome-trace export, and — against a live cluster — the
+state/dashboard surfaces plus the dashboard's ETag/304 conditional GET.
+
+The slow-marked guard test at the bottom is the tentpole's overhead
+budget: recorder-on sync actor-call throughput must stay within 3% of
+recorder-off.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from ray_tpu._private import flight_recorder as fr
+from ray_tpu._private import rpc
+
+
+# ---------------------------------------------------------------------------
+# Decomposition math (no cluster).
+# ---------------------------------------------------------------------------
+class TestFinishCall:
+    def setup_method(self):
+        fr.reset_calls()
+        fr.set_enabled(True)
+
+    def test_phases_telescope_to_e2e(self):
+        rec = {"fn": "unit_tel", "t0": time.perf_counter_ns() - 1_000_000,
+               "pre_serialize_ns": 100_000, "serialize_ns": 50_000,
+               "frame_ns": 30_000, "syscall_ns": 70_000}
+        fr.finish_call(rec, server_ns=300_000, exec_ns=120_000,
+                       reply_ns=80_000)
+        agg = fr.overhead_breakdown()["unit_tel"]
+        # serialize folds pre_serialize + serialize; dispatch is
+        # server - exec; wire is the measured remainder.
+        assert agg["serialize"]["mean_us"] == 150.0
+        assert agg["frame"]["mean_us"] == 30.0
+        assert agg["syscall"]["mean_us"] == 70.0
+        assert agg["dispatch"]["mean_us"] == 180.0
+        assert agg["exec"]["mean_us"] == 120.0
+        assert agg["reply"]["mean_us"] == 80.0
+        assert agg["e2e"]["mean_us"] >= 1000.0
+        # the contract the smoke test + ISSUE acceptance lean on
+        assert 0.99 <= agg["coverage"] <= 1.01
+
+    def test_batch_amortizes_per_call(self):
+        rec = {"fn": "unit_batch", "t0": time.perf_counter_ns() - 1_000_000,
+               "serialize_ns": 200_000}
+        fr.finish_call(rec, server_ns=400_000, exec_ns=100_000, n=10)
+        agg = fr.overhead_breakdown()["unit_batch"]
+        assert agg["serialize"]["mean_us"] == 20.0  # 200µs over 10 calls
+        assert agg["exec"]["mean_us"] == 10.0
+        assert agg["e2e"]["mean_us"] >= 100.0
+        assert 0.99 <= agg["coverage"] <= 1.01
+
+    def test_wire_clamped_nonnegative(self):
+        # Server claims more time than the client observed end-to-end
+        # (clock jitter shape): wire must clamp to 0, never negative.
+        rec = {"fn": "unit_clamp", "t0": time.perf_counter_ns() - 10_000}
+        fr.finish_call(rec, server_ns=50_000_000, exec_ns=1_000)
+        agg = fr.overhead_breakdown()["unit_clamp"]
+        assert agg["wire"]["mean_us"] == 0.0
+
+    def test_exec_capped_by_server_total(self):
+        rec = {"fn": "unit_cap", "t0": time.perf_counter_ns() - 1_000_000}
+        fr.finish_call(rec, server_ns=100_000, exec_ns=999_999_999)
+        agg = fr.overhead_breakdown()["unit_cap"]
+        assert agg["exec"]["mean_us"] == 100.0
+        assert agg["dispatch"]["mean_us"] == 0.0
+
+    def test_from_reply_single_and_batch(self):
+        rec = {"fn": "unit_single", "t0": time.perf_counter_ns() - 500_000}
+        fr.finish_call_from_reply(
+            rec, {"ok": 1, "_frs": 200_000, "_frx": 150_000},
+            reply_ns=10_000)
+        agg = fr.overhead_breakdown()["unit_single"]
+        assert agg["exec"]["mean_us"] == 150.0
+        assert agg["dispatch"]["mean_us"] == 50.0
+
+        rec = {"fn": "unit_rbatch", "t0": time.perf_counter_ns() - 500_000}
+        fr.finish_call_from_reply(
+            rec, {"replies": [{"_frx": 40_000}, {"_frx": 60_000}],
+                  "_frs": 200_000})
+        agg = fr.overhead_breakdown()["unit_rbatch"]
+        assert agg["exec"]["mean_us"] == 50.0       # (40+60)µs over n=2
+        assert agg["dispatch"]["mean_us"] == 50.0   # (200-100)µs over n=2
+
+    def test_non_dict_reply_still_closes(self):
+        rec = {"fn": "unit_nondict", "t0": time.perf_counter_ns() - 100_000}
+        fr.finish_call_from_reply(rec, None)
+        assert "unit_nondict" in fr.overhead_breakdown()
+
+    def test_sampling_gate(self):
+        fr.set_enabled(False)
+        try:
+            assert fr.maybe_begin_call("x") is None
+        finally:
+            fr.set_enabled(True)
+        old = fr._SAMPLE_EVERY
+        fr._SAMPLE_EVERY = 1
+        try:
+            rec = fr.maybe_begin_call("unit_gate")
+        finally:
+            fr._SAMPLE_EVERY = old
+        assert rec is not None and rec["fn"] == "unit_gate"
+        assert rec["t0"] <= time.perf_counter_ns()
+
+
+# ---------------------------------------------------------------------------
+# Wire accounting through the real frame build/read path.
+# ---------------------------------------------------------------------------
+class TestWireAccounting:
+    def test_frame_parts_counts_tx(self):
+        before = fr.wire_summary()["tx"].get("request/async",
+                                             {"frames": 0, "bytes": 0})
+        parts = rpc._frame_parts(0, 1, {"method": "m", "kwargs": {}})
+        nbytes = sum(len(p) for p in parts)
+        after = fr.wire_summary()["tx"]["request/async"]
+        assert after["frames"] == before["frames"] + 1
+        assert after["bytes"] == before["bytes"] + nbytes
+        # small control frame: everything coalesced into one buffer
+        assert len(parts) == 1
+        assert after["parts_sent"] >= after["frames"]
+        assert after["coalesce_ratio"] >= 1.0
+
+    def test_fast_lane_accounted_separately(self):
+        before = fr.wire_summary()["tx"].get("request/fast",
+                                             {"frames": 0})["frames"]
+        rpc._frame_parts(0, 2, {"method": "m"}, lane="fast")
+        assert fr.wire_summary()["tx"]["request/fast"]["frames"] == \
+            before + 1
+
+    def test_frame_parts_stamps_rec(self):
+        rec = {"fn": "x", "t0": time.perf_counter_ns()}
+        rpc._frame_parts(0, 3, {"method": "m", "payload": b"z" * 4096},
+                         rec=rec)
+        assert rec["serialize_ns"] > 0
+        assert rec["frame_ns"] > 0
+
+    def test_send_syscalls_counter(self):
+        before = fr.wire_summary()["send_calls"].get("fast", 0)
+        fr.wire_sends("fast", 3)
+        assert fr.wire_summary()["send_calls"]["fast"] == before + 3
+
+
+# ---------------------------------------------------------------------------
+# Ring buffer + chrome trace export.
+# ---------------------------------------------------------------------------
+class TestRingAndTrace:
+    def test_ring_is_bounded(self):
+        for i in range(fr._RING_CAP + 100):
+            fr.record_event("unit_flood", i=i)
+        evs = fr.dump_events()
+        assert len(evs) == fr._RING_CAP
+        assert all("ts" in e for e in evs[-5:])
+
+    def test_trace_grammar(self):
+        events = [
+            {"kind": "call", "ts": 100.0, "fn": "f", "n": 2, "e2e": 500.0,
+             "serialize": 10.0, "wire": 400.0},
+            {"kind": "loop_stall", "ts": 101.0, "loop": "gcs",
+             "held_s": 0.2, "stack": ["a.py:1:f"]},
+            {"kind": "store_put", "ts": 102.0, "nbytes": 1 << 23,
+             "total_us": 900.0, "alloc_us": 100.0},
+            {"kind": "drain_stall", "ts": 103.0, "seconds": 0.01},
+        ]
+        rows = fr.chrome_trace_events(events, pid="test-pid")
+        assert [r["ph"] for r in rows] == ["X", "X", "X", "i"]
+        call, stall, put, instant = rows
+        assert call["name"] == "call:f" and call["dur"] == 500.0
+        assert call["ts"] == pytest.approx(100.0 * 1e6 - 500.0)
+        assert call["args"]["n"] == 2 and call["args"]["wire"] == 400.0
+        assert stall["dur"] == pytest.approx(0.2 * 1e6)
+        assert stall["args"]["stack"] == ["a.py:1:f"]
+        assert put["tid"] == "store" and put["args"]["nbytes"] == 1 << 23
+        assert instant["s"] == "p" and instant["args"]["seconds"] == 0.01
+        for r in rows:  # the merged-timeline contract: args always present
+            assert {"name", "ph", "ts", "pid", "tid", "args"} <= set(r)
+            assert r["pid"] == "test-pid" and r["cat"] == "FLIGHT"
+        json.dumps(rows)  # must be trace-file serializable
+
+
+# ---------------------------------------------------------------------------
+# Event-loop lag sampler + stall watchdog on a real EventLoopThread.
+# ---------------------------------------------------------------------------
+class TestLoopLag:
+    def test_samples_and_stall_attribution(self):
+        fr.set_enabled(True)
+        elt = rpc.EventLoopThread(name="fr_test_loop")
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if fr.loop_lag_summary().get("fr_test_loop",
+                                             {}).get("samples", 0) >= 2:
+                    break
+                time.sleep(0.05)
+            summary = fr.loop_lag_summary()["fr_test_loop"]
+            assert summary["samples"] >= 2
+            assert summary["p50_ms"] < 1000.0  # idle loop: lag ~ 0
+
+            # Hold the loop well past RAY_TPU_LOOP_STALL_MS: the watchdog
+            # must count a stall and capture the offender's stack. Retry
+            # the injection: on a loaded 1-core host the watchdog thread
+            # may not get a GIL slot inside one stall window.
+            hold = fr._LAG_INTERVAL_S + fr._STALL_THRESHOLD_S + 0.6
+            stall_evs = []
+            for _ in range(3):
+                elt.loop.call_soon_threadsafe(lambda: time.sleep(hold))
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline:
+                    stall_evs = [e for e in fr.dump_events()
+                                 if e.get("kind") == "loop_stall"
+                                 and e.get("loop") == "fr_test_loop"]
+                    if stall_evs:
+                        break
+                    time.sleep(0.05)
+                if stall_evs:
+                    break
+            assert fr.loop_lag_summary()["fr_test_loop"]["stalls"] >= 1
+            assert stall_evs, "stall not recorded in the ring"
+            # sys._current_frames caught the callback in the act
+            assert any("sleep" in frame_line or "test_flight_recorder"
+                       in frame_line
+                       for frame_line in stall_evs[-1]["stack"])
+        finally:
+            elt.stop()
+
+    def test_attach_is_idempotent(self):
+        loop = asyncio.new_event_loop()
+        try:
+            fr.attach_loop(loop, "fr_dup")
+            fr.attach_loop(loop, "fr_dup")
+            assert sum(1 for m in fr._loops.values()
+                       if m.name == "fr_dup") <= 1
+        finally:
+            loop.close()
+
+
+# ---------------------------------------------------------------------------
+# Publisher: accumulated deltas become real metrics.
+# ---------------------------------------------------------------------------
+class TestPublisher:
+    def test_publish_now_creates_and_feeds_metrics(self):
+        fr.wire_tx(0, "async", 1000, parts_built=5, parts_sent=2)
+        fr.wire_rx(1, "async", 500)
+        fr.wire_sends("async", 2)
+        fr.publish_now()
+        for key in ("frames", "bytes", "parts", "syscalls", "coalesce",
+                    "lag", "lag_max", "stalls"):
+            assert key in fr._metrics, f"publisher metric {key} missing"
+        # Delta publishing: a second pass with no new traffic must not
+        # raise (and publishes zero deltas).
+        fr.publish_now()
+
+    def test_direct_histograms_bind_lazily(self):
+        fr.note_batch("actor", 16)
+        assert "ray_tpu_rpc_batch_size" in fr._hists
+        fr.note_drain_stall(0.01)
+        assert "ray_tpu_rpc_drain_stall_seconds" in fr._hists
+        assert any(e.get("kind") == "drain_stall"
+                   for e in fr.dump_events())
+
+
+# ---------------------------------------------------------------------------
+# Live-cluster integration: state surfaces, store phases, timeline merge,
+# dashboard ETag.
+# ---------------------------------------------------------------------------
+class TestClusterIntegration:
+    @pytest.fixture(autouse=True)
+    def _sample_everything(self):
+        old = fr._SAMPLE_EVERY
+        fr._SAMPLE_EVERY = 1
+        fr.set_enabled(True)
+        fr.reset_calls()
+        yield
+        fr._SAMPLE_EVERY = old
+
+    def test_state_surfaces_and_store_phases(self, ray_cluster):
+        import numpy as np
+
+        from ray_tpu.util import state
+
+        @ray_cluster.remote
+        class Echo:
+            def ping(self):
+                return 1
+
+        a = Echo.remote()
+        ray_cluster.get(a.ping.remote())
+        for _ in range(30):
+            ray_cluster.get(a.ping.remote())
+        # large put: phase-timed always (>= 1 MiB) + ring event (>= 8 MiB)
+        ref = ray_cluster.put(np.ones(8 << 20, np.uint8))
+        ray_cluster.get(ref)
+
+        breakdown = state.overhead_breakdown()
+        assert breakdown["driver"], "driver breakdown empty"
+        ping = next((v for k, v in breakdown["driver"].items()
+                     if "ping" in k), None)
+        assert ping is not None
+        assert 0.85 <= ping["coverage"] <= 1.15
+        assert ping["e2e"]["count"] >= 25
+        assert isinstance(breakdown["nodes"], dict)
+
+        record = state.flight_record()
+        drv = record["driver"]
+        assert drv["enabled"]
+        assert drv["wire"]["tx"], "no tx wire rows on a live cluster"
+        assert any(e.get("kind") == "store_put" and e["nbytes"] >= 8 << 20
+                   for e in drv["events"])
+        put_ev = next(e for e in drv["events"]
+                      if e.get("kind") == "store_put")
+        # phase stamps present and within the measured total
+        assert put_ev["alloc_us"] + put_ev["memcpy_us"] + put_ev["seal_us"] \
+            <= put_ev["total_us"] * 1.01
+        assert put_ev["gib_per_s"] > 0
+
+        events = state.timeline()
+        flight = [e for e in events if e.get("cat") == "FLIGHT"]
+        assert flight, "timeline missing merged flight events"
+        assert all("args" in e for e in flight)
+        assert any(e["name"].startswith("call:") for e in flight)
+
+        # Cross-process surface: this driver's budget must be visible to
+        # OTHER processes (CLI / dashboard) via the GCS KV export.
+        fr.publish_now()  # forces the KV export synchronously
+        snaps = state._driver_kv_snapshots(include_self=True)
+        mine = snaps.get(str(os.getpid()))
+        assert mine, f"driver KV snapshot missing: {sorted(snaps)}"
+        assert any("ping" in k for k in mine["breakdown"])
+        assert mine["wire"]["tx"] and mine["events"]
+        # ...and by default the querying process excludes itself.
+        assert str(os.getpid()) not in state._driver_kv_snapshots()
+
+    def test_dashboard_etag_304(self, ray_cluster):
+        import http.client
+
+        from ray_tpu.dashboard import start_dashboard
+
+        port = start_dashboard()
+
+        def get(path, headers=None):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            try:
+                conn.request("GET", path, headers=headers or {})
+                resp = conn.getresponse()
+                return resp.status, resp.getheader("ETag"), resp.read()
+            finally:
+                conn.close()
+
+        # /healthz body is constant, so its ETag must round-trip to 304.
+        status, etag, body = get("/healthz")
+        assert status == 200 and body == b'"ok"'
+        assert etag, "200 response missing ETag"
+        status2, etag2, body2 = get("/healthz",
+                                    {"If-None-Match": etag})
+        assert status2 == 304 and body2 == b""
+        assert etag2 == etag
+        # stale validator -> full 200 again
+        status3, _, body3 = get("/healthz", {"If-None-Match": '"dead"'})
+        assert status3 == 200 and body3 == b'"ok"'
+        # the new JSON surfaces exist end-to-end
+        status4, _, body4 = get("/api/profile/overhead")
+        assert status4 == 200 and b"driver" in body4
+        status5, _, body5 = get("/api/flight_record")
+        assert status5 == 200 and b"wire" in body5
+
+
+# ---------------------------------------------------------------------------
+# Overhead guard (ISSUE acceptance): recorder-on within 3% of recorder-off
+# on the 1_1_actor_calls_sync shape. Slow-marked: a sustained timed loop.
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_recorder_overhead_within_3_percent():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, object_store_memory=256 * 1024 * 1024)
+    try:
+        @ray_tpu.remote
+        class Echo:
+            def ping(self):
+                return None
+
+        a = Echo.remote()
+        for _ in range(100):
+            ray_tpu.get(a.ping.remote())  # warm: spawn, conns, JIT caches
+
+        def lat_block(n: int = 500) -> list:
+            out = []
+            for _ in range(n):
+                t0 = time.perf_counter_ns()
+                ray_tpu.get(a.ping.remote())
+                out.append(time.perf_counter_ns() - t0)
+            return out
+
+        # Interleave off/on blocks so slow host-level drift (page cache,
+        # cgroup accounting, unrelated daemons) hits both sides equally,
+        # then compare low percentiles of per-call latency. Interference
+        # on a shared host is one-sided — it only ever slows a call down
+        # — so p10 over ~5k calls per side tracks the intrinsic path
+        # length; throughput-per-round estimators absorb whichever side
+        # a noise burst happened to land on (a control run of the round
+        # protocol with the recorder never enabled spread 0.88x–1.07x,
+        # useless for a 3% assertion on this hardware).
+        offs, ons = [], []
+        for _ in range(10):
+            fr.set_enabled(False)
+            offs += lat_block()
+            fr.set_enabled(True)
+            ons += lat_block()
+        off = sorted(offs)[len(offs) // 10]
+        on = sorted(ons)[len(ons) // 10]
+    finally:
+        fr.set_enabled(True)
+        ray_tpu.shutdown()
+    assert on <= off * 1.03, (
+        f"flight recorder costs more than 3%: p10 on={on / 1e3:.1f}us "
+        f"off={off / 1e3:.1f}us ({on / off:.3f}x)")

@@ -1,0 +1,130 @@
+"""Unit coverage for the in-process sampling profiler
+(ray_tpu/_private/profiler.py): folded-stack sampling, multi-profile
+merge, trie building, and the flamegraph HTML renderer. These run
+without a cluster — the profiler samples the current process."""
+
+import threading
+import time
+
+from ray_tpu._private.profiler import (
+    _build_trie,
+    _trie_json,
+    flamegraph_html,
+    merge_folded,
+    sample_folded,
+)
+
+
+def _busy_marker_fn(stop):
+    # The co_name below must survive into the folded stack keys.
+    x = 0
+    while not stop.is_set():
+        x = (x + 1) % 1000003
+    return x
+
+
+class TestSampleFolded:
+    def test_captures_busy_thread(self):
+        stop = threading.Event()
+        t = threading.Thread(target=_busy_marker_fn, args=(stop,),
+                             name="busy-marker")
+        t.start()
+        try:
+            prof = sample_folded(duration_s=0.5, hz=200)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert prof["samples"] > 0
+        assert prof["folded"], "no stacks sampled"
+        # Folded keys: "thread:NAME;outermost (file:line);...;innermost".
+        keys = list(prof["folded"])
+        assert all(k.startswith("thread:") for k in keys)
+        assert any("_busy_marker_fn" in k and "thread:busy-marker" in k
+                   for k in keys), keys
+
+    def test_excludes_own_thread_and_reports_metadata(self):
+        prof = sample_folded(duration_s=0.2, hz=100)
+        # The sampling loop must not profile itself.
+        me = threading.current_thread().name
+        assert not any(k.startswith(f"thread:{me};") for k in prof["folded"])
+        assert prof["hz"] == 100
+        assert 0.15 <= prof["duration_s"] <= 2.0
+        assert prof["pid"]
+
+    def test_hz_clamped(self):
+        prof = sample_folded(duration_s=0.05, hz=99999)
+        assert prof["hz"] == 1000.0
+
+
+class TestMergeFolded:
+    def test_labels_become_root_frames(self):
+        a = {"folded": {"thread:main;f (m.py:1)": 3}, "samples": 3,
+             "duration_s": 1.0, "hz": 99}
+        b = {"folded": {"thread:main;g (m.py:2)": 2}, "samples": 2,
+             "duration_s": 2.5, "hz": 99}
+        out = merge_folded([("w1", a), ("w2", b)])
+        assert out["folded"] == {
+            "w1;thread:main;f (m.py:1)": 3,
+            "w2;thread:main;g (m.py:2)": 2,
+        }
+        assert out["samples"] == 5
+        assert out["duration_s"] == 2.5  # max, not sum: sampled in parallel
+        assert out["hz"] == 99
+
+    def test_same_label_accumulates(self):
+        a = {"folded": {"thread:main;f (m.py:1)": 1}, "samples": 1,
+             "duration_s": 1.0, "hz": 99}
+        out = merge_folded([("w", a), ("w", a)])
+        assert out["folded"]["w;thread:main;f (m.py:1)"] == 2
+
+    def test_invalid_profiles_skipped(self):
+        good = {"folded": {"thread:main;f (m.py:1)": 1}, "samples": 1,
+                "duration_s": 0.5, "hz": 99}
+        out = merge_folded([
+            ("dead", {"error": "worker crashed"}),
+            ("none", None),
+            ("str", "oops"),
+            ("ok", good),
+        ])
+        assert list(out["folded"]) == ["ok;thread:main;f (m.py:1)"]
+        assert out["samples"] == 1
+
+
+class TestTrie:
+    def test_build_trie_shares_prefixes(self):
+        root = _build_trie({"a;b": 2, "a;c": 3, "d": 1})
+        assert root["v"] == 6
+        assert set(root["c"]) == {"a", "d"}
+        assert root["c"]["a"]["v"] == 5
+        assert root["c"]["a"]["c"]["b"]["v"] == 2
+        assert root["c"]["a"]["c"]["c"]["v"] == 3
+        assert root["c"]["d"]["v"] == 1 and not root["c"]["d"]["c"]
+
+    def test_trie_json_sorted_by_weight(self):
+        j = _trie_json(_build_trie({"a;b": 2, "a;c": 3}))
+        assert j == {
+            "name": "all", "value": 5, "children": [
+                {"name": "a", "value": 5, "children": [
+                    {"name": "c", "value": 3, "children": []},
+                    {"name": "b", "value": 2, "children": []},
+                ]}]}
+
+    def test_empty_folded(self):
+        j = _trie_json(_build_trie({}))
+        assert j == {"name": "all", "value": 0, "children": []}
+
+
+class TestFlamegraphHtml:
+    def test_embeds_trie_and_metadata(self):
+        prof = {"folded": {"thread:main;work (m.py:7)": 4},
+                "samples": 4, "duration_s": 1.0, "hz": 99}
+        html = flamegraph_html(prof)
+        assert html.startswith("<!doctype html>")
+        assert "work (m.py:7)" in html
+        assert '"value": 4' in html
+        assert "4 samples @ 99 Hz" in html
+
+    def test_tolerates_missing_fields(self):
+        html = flamegraph_html({})
+        assert "<!doctype html>" in html
+        assert '"value": 0' in html
