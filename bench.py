@@ -147,6 +147,29 @@ def bench_put_gigabytes(ray_tpu, size_mb=100, iters=10):
     return size_mb * iters / 1024 / dt
 
 
+def bench_data_pipeline(ray_tpu, n_rows=200_000, block_rows=5_000):
+    """3-stage data pipeline (source → task map → actor-pool map) on the
+    op-DAG streaming executor: end-to-end rows/s with all operators
+    running concurrently under the default store budget."""
+    import time
+
+    import ray_tpu.data as rd
+
+    class Scale:
+        def __call__(self, b):
+            return {"id": b["id"] * 3}
+
+    ds = (rd.range(n_rows, block_rows=block_rows)
+          .map_batches(lambda b: {"id": b["id"] + 1},
+                       batch_size=block_rows)
+          .map_batches(Scale, batch_size=block_rows, concurrency=2))
+    t0 = time.perf_counter()
+    rows = sum(len(b["id"]) for b in ds.iter_blocks())
+    dt = time.perf_counter() - t0
+    assert rows == n_rows, (rows, n_rows)
+    return rows / dt
+
+
 def bench_tpu_model():
     """Model-level TPU metrics (MFU, tokens/s, flash kernel speedup). Runs
     inside the --model-bench-only SUBPROCESS (see _model_bench_subprocess),
@@ -354,6 +377,17 @@ def main():
                     if s and s["value"] > row["value"]:
                         row.update(s)
                         row["remeasured_solo"] = True
+            try:
+                data_rows_s = bench_data_pipeline(ray_tpu)
+                table.append({"name": "data_pipeline_3stage_rows",
+                              "value": round(data_rows_s, 1),
+                              "unit": "rows/s", "vs_baseline": None})
+                print(f"data_pipeline_3stage_rows: {data_rows_s:.0f}/s "
+                      "(streaming executor, task+actor stages)",
+                      file=sys.stderr)
+            except Exception as e:  # noqa: BLE001
+                print(f"data pipeline bench skipped: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
             with open(os.path.join(os.path.dirname(__file__) or ".",
                                    "MICROBENCH.json"), "w") as f:
                 json.dump({"host": "1-core driver host",

@@ -1,8 +1,8 @@
 """ray_tpu.data — streaming datasets over the task/object plane.
 
 Reference: python/ray/data (Dataset, read_api, DataIterator). See dataset.py
-for the redesign notes (numpy-dict blocks, generator-chain streaming
-executor)."""
+for the block/plan redesign notes (numpy-dict blocks) and _execution/ for
+the op-DAG streaming executor all plans run on."""
 
 from ray_tpu.data.block import Block, BlockMetadata
 from ray_tpu.data.dataset import (
@@ -18,11 +18,15 @@ from ray_tpu.data.dataset import (
     read_text,
 )
 from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data._execution import (
+    recent_execution_summaries as execution_summaries,
+)
 
 __all__ = [
     "Block",
     "BlockMetadata",
     "DataIterator",
+    "execution_summaries",
     "Dataset",
     "from_items",
     "from_numpy",

@@ -1,0 +1,85 @@
+"""Per-dataset actor-pool autoscaler policy.
+
+Reference: python/ray/data/_internal/execution/autoscaler/
+default_autoscaler.py (scale an ActorPoolMapOperator on input-queue
+pressure / idle actors). The flap-control discipline — hysteresis delay
+windows, post-decision cooldowns, bounded per-cycle step, min/max
+clamps — is the one proven in serve/_autoscaling.py; this is the data
+plane's instance of it, driven by block queues instead of request
+gauges.
+
+Pure in-process state with explicit ``now`` so every branch is
+unit-testable without a cluster or sleeps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+DEFAULTS: Dict[str, float] = {
+    # Scale up when the input queue holds more than this many blocks
+    # per actor (work the pool cannot have in flight), sustained.
+    "up_queue_per_actor": 1.0,
+    "up_delay_s": 0.2,
+    "down_delay_s": 0.5,
+    # Refractory period after an applied decision, so actor boot/drain
+    # latency never double-fires.
+    "up_cooldown_s": 0.2,
+    "down_cooldown_s": 0.3,
+    # Bounded actuation: one tick never adds/removes more than this.
+    "max_step": 1,
+}
+
+
+class PoolAutoscalerPolicy:
+    """Decides pool-size deltas for one actor-pool operator.
+
+    ``tick`` returns +k to grow, -k to shrink (only ever up to the
+    number of *idle* actors — scale-down is drain-based: a running task
+    is never killed under an actor), or 0."""
+
+    def __init__(self, min_size: int, max_size: int,
+                 config: Optional[Dict[str, Any]] = None):
+        cfg = dict(DEFAULTS)
+        cfg.update(config or {})
+        self.min_size = max(1, int(min_size))
+        self.max_size = max(self.min_size, int(max_size))
+        self.up_queue_per_actor = float(cfg["up_queue_per_actor"])
+        self.up_delay_s = float(cfg["up_delay_s"])
+        self.down_delay_s = float(cfg["down_delay_s"])
+        self.up_cooldown_s = float(cfg["up_cooldown_s"])
+        self.down_cooldown_s = float(cfg["down_cooldown_s"])
+        self.max_step = max(1, int(cfg["max_step"]))
+        self._up_since: Optional[float] = None
+        self._down_since: Optional[float] = None
+        self._cooldown_until = 0.0
+
+    def tick(self, now: float, *, queued: int, pool_size: int,
+             idle: int) -> int:
+        want_up = (queued > pool_size * self.up_queue_per_actor
+                   and pool_size < self.max_size)
+        want_down = (queued == 0 and idle > 0
+                     and pool_size > self.min_size)
+        if want_up:
+            self._down_since = None
+            if self._up_since is None:
+                self._up_since = now
+            if (now >= self._cooldown_until
+                    and now - self._up_since >= self.up_delay_s):
+                self._up_since = None
+                self._cooldown_until = now + self.up_cooldown_s
+                return min(self.max_step, self.max_size - pool_size)
+        elif want_down:
+            self._up_since = None
+            if self._down_since is None:
+                self._down_since = now
+            if (now >= self._cooldown_until
+                    and now - self._down_since >= self.down_delay_s):
+                self._down_since = None
+                self._cooldown_until = now + self.down_cooldown_s
+                # Drain-based: never shrink past what is provably idle.
+                return -min(self.max_step, idle,
+                            pool_size - self.min_size)
+        else:
+            self._up_since = self._down_since = None
+        return 0

@@ -1,0 +1,126 @@
+"""Physical-operator interfaces for the streaming executor.
+
+Reference: python/ray/data/_internal/execution/interfaces/ — RefBundle
+(refs + metadata, the unit flowing between operators) and
+PhysicalOperator (bounded queues, task accounting). Redesigned small:
+a bundle is one block ref plus whatever metadata is cheaply knowable;
+operators are plain objects polled by the driver-side scheduling loop,
+not actors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Deque, Dict, Optional
+
+
+@dataclasses.dataclass
+class RefBundle:
+    """One block ObjectRef + metadata. ``size_bytes``/``num_rows`` are
+    None when unknowable without a payload fetch (e.g. pre-materialized
+    refs) — byte accounting then counts 0, never guesses."""
+
+    ref: Any
+    num_rows: Optional[int] = None
+    size_bytes: Optional[int] = None
+
+    def bytes_or(self, default: int = 0) -> int:
+        return self.size_bytes if self.size_bytes is not None else default
+
+
+class PhysicalOperator:
+    """Base physical operator: bounded in/out block-ref queues plus the
+    hooks the scheduling loop drives (launch/poll/flow). Subclasses:
+    InputDataBuffer (produces), map operators (transform via tasks or an
+    actor pool), OutputSplitter (deals to N consumer queues)."""
+
+    is_map = False
+
+    def __init__(self, name: str, *, num_cpus: float = 1.0,
+                 window: int = 4, max_inqueue: Optional[int] = None,
+                 max_outqueue: Optional[int] = None):
+        self.name = name
+        self.num_cpus = num_cpus
+        # ``window`` is what the backpressure chain (planner.effective_
+        # window) reads as the configured concurrency cap.
+        self.window = max(1, int(window))
+        self.inqueue: Deque[RefBundle] = deque()
+        self.outqueue: Deque[RefBundle] = deque()
+        self.max_inqueue = max_inqueue or max(2, 2 * self.window)
+        self.max_outqueue = max_outqueue or max(2, self.window)
+        self.inputs_done = False
+        # Lifetime throughput counters (telemetry + summaries).
+        self.blocks_out = 0
+        self.rows_out = 0
+        self.bytes_out = 0
+        self.peak_inflight = 0
+        self.peak_queued = 0
+
+    # -- queue plumbing (driven by the executor's flow phase) -----------
+    def add_input(self, bundle: RefBundle) -> None:
+        self.inqueue.append(bundle)
+        self.peak_queued = max(self.peak_queued, len(self.inqueue))
+
+    def mark_inputs_done(self) -> None:
+        self.inputs_done = True
+
+    def can_accept_input(self) -> bool:
+        return len(self.inqueue) < self.max_inqueue
+
+    def outqueue_bytes(self) -> int:
+        return sum(b.bytes_or(0) for b in self.outqueue)
+
+    def _emit(self, bundle: RefBundle) -> None:
+        self.outqueue.append(bundle)
+        self.blocks_out += 1
+        if bundle.num_rows is not None:
+            self.rows_out += bundle.num_rows
+        if bundle.size_bytes is not None:
+            self.bytes_out += bundle.size_bytes
+
+    # -- scheduling hooks ----------------------------------------------
+    def can_launch(self) -> bool:
+        return False
+
+    def launch_one(self) -> None:
+        raise NotImplementedError
+
+    def poll(self) -> bool:
+        """Harvest finished work into the output queue; True if anything
+        progressed."""
+        return False
+
+    def num_inflight(self) -> int:
+        return 0
+
+    def pending_outputs(self) -> int:
+        """Results already owed to the output queue (in-flight tasks +
+        completed-but-unordered buffers) — counted against the output
+        bound so an op can never owe more than its queue can hold."""
+        return self.num_inflight()
+
+    def exhausted(self) -> bool:
+        """No more outputs will ever be produced (outqueue may still
+        hold already-produced bundles)."""
+        return self.inputs_done and not self.inqueue \
+            and self.num_inflight() == 0
+
+    def shutdown(self) -> None:
+        pass
+
+    # -- telemetry ------------------------------------------------------
+    def stat_row(self) -> Dict[str, Any]:
+        return {
+            "blocks_out": self.blocks_out,
+            "rows_out": self.rows_out,
+            "bytes_out": self.bytes_out,
+            "queued_blocks": len(self.inqueue),
+            "inflight": self.num_inflight(),
+            "peak_inflight": self.peak_inflight,
+            "peak_queued": self.peak_queued,
+        }
+
+    def __repr__(self) -> str:
+        return (f"<{type(self).__name__} {self.name} in={len(self.inqueue)} "
+                f"out={len(self.outqueue)} inflight={self.num_inflight()}>")

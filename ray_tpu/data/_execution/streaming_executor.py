@@ -1,0 +1,349 @@
+"""The streaming scheduling loop.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py:52
+(scheduling loop at :277-352) and streaming_executor_state.py
+(select_operator_to_run: prefer the runnable operator with the smallest
+output queue). Redesign: **pump-on-pull** instead of a background
+scheduler thread. ``next_output()`` runs scheduling ticks inline until
+the sink has a block; between pulls, in-flight tasks keep progressing in
+workers. No thread means the executor is safe inside actor processes
+(the streaming_split coordinator runs one) and exceptions surface on
+the consumer's stack, not a daemon's.
+
+Each tick:
+  1. poll every operator (harvest finished tasks → output queues),
+  2. flow outputs downstream through bounded input queues and propagate
+     end-of-input,
+  3. autoscale actor pools,
+  4. repeatedly launch on the runnable operator with the smallest
+     output queue whose launch fits its ResourceManager reservation +
+     shared-pool borrow — and the execution's store-byte budget.
+
+Budget gating (ExecutionBudget.store_bytes): when the resident-byte
+headroom is exhausted, only the operator **deepest in the DAG** with
+pending input may launch — consuming toward the sink is what frees
+bytes, so drain must never be blocked by the very pressure it relieves
+(the classic budget deadlock when one block exceeds the budget).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Iterator, List, Optional
+
+from ray_tpu.data._execution.interfaces import PhysicalOperator, RefBundle
+from ray_tpu.data._execution.operators import (
+    ActorPoolMapOperator,
+    InputDataBuffer,
+    OutputSplitter,
+    TaskPoolMapOperator,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Metric names (also asserted by scripts/check_metrics_contract.py —
+# keep as plain string literals).
+_M_ROWS = "ray_tpu_data_op_output_rows_total"
+_M_BLOCKS = "ray_tpu_data_op_output_blocks_total"
+_M_QUEUED = "ray_tpu_data_op_queued_blocks"
+_M_INFLIGHT = "ray_tpu_data_op_inflight_tasks"
+_M_POOL = "ray_tpu_data_actor_pool_size"
+_M_BYTES = "ray_tpu_data_queued_bytes"
+_M_AUTOSCALE = "ray_tpu_data_autoscale_events_total"
+
+_METRICS_PERIOD_S = 0.25
+_STALL_TIMEOUT_S = float(os.environ.get("RAY_TPU_DATA_STALL_S", "60"))
+
+# Ring of finished-execution summaries, newest last
+# (ray_tpu.data.execution_summaries() is the public accessor).
+_RECENT: Deque[Dict[str, Any]] = deque(maxlen=32)
+
+
+def recent_execution_summaries() -> List[Dict[str, Any]]:
+    return list(_RECENT)
+
+
+class StreamingExecutor:
+    """Executes one fused logical plan as a DAG of physical operators.
+
+    ``split_n``: terminate the DAG in an OutputSplitter dealing to that
+    many consumer queues (streaming_split); otherwise the last
+    operator's output queue is the sink.
+    """
+
+    def __init__(self, plan: List[Any], budget: Any = None,
+                 split_n: Optional[int] = None):
+        from ray_tpu.data import planner
+        from ray_tpu.data.dataset import (
+            _MapBatches,
+            _MapBatchesActor,
+            _fuse_plan,
+        )
+
+        plan = _fuse_plan(plan)
+        self._rm = planner.ResourceManager(
+            budget or planner.default_execution_budget())
+        self.ops: List[PhysicalOperator] = [
+            InputDataBuffer(plan[0], self._rm)]
+        for logical in plan[1:]:
+            if isinstance(logical, _MapBatchesActor):
+                self.ops.append(ActorPoolMapOperator(
+                    logical, self._rm,
+                    on_scale_event=self._record_autoscale))
+            elif isinstance(logical, _MapBatches):
+                self.ops.append(TaskPoolMapOperator(logical, self._rm))
+            else:
+                raise TypeError(
+                    f"unknown logical op in plan: {logical!r}")
+        self.splitter: Optional[OutputSplitter] = None
+        if split_n is not None:
+            self.splitter = OutputSplitter(split_n, self._rm)
+            self.ops.append(self.splitter)
+        # Reservations are split among ops that actually hold cpu slots.
+        self._rm.register_ops([op for op in self.ops if op.is_map])
+        self.sink = self.ops[-1]
+        self.dataset_tag = self.sink.name if self.splitter is None \
+            else self.ops[-2].name
+        self.max_concurrent_ops = 0
+        self._autoscale_events = 0
+        self._started_at = time.monotonic()
+        self._last_progress = time.monotonic()
+        self._last_metrics = 0.0
+        self._shutdown = False
+        self._metrics = self._make_metrics()
+
+    # -- telemetry ------------------------------------------------------
+    def _make_metrics(self) -> Dict[str, Any]:
+        from ray_tpu.util.metrics import get_counter, get_gauge
+
+        return {
+            "rows": get_counter(_M_ROWS,
+                                "rows emitted per data operator"),
+            "blocks": get_counter(_M_BLOCKS,
+                                  "blocks emitted per data operator"),
+            "queued": get_gauge(_M_QUEUED,
+                                "blocks waiting in operator input queues"),
+            "inflight": get_gauge(_M_INFLIGHT,
+                                  "tasks in flight per data operator"),
+            "pool": get_gauge(_M_POOL, "actor-pool size per data operator"),
+            "bytes": get_gauge(_M_BYTES,
+                               "bytes resident in execution queues"),
+            "autoscale": get_counter(
+                _M_AUTOSCALE, "data actor-pool scale up/down events"),
+        }
+
+    def _record_autoscale(self, direction: str) -> None:
+        self._autoscale_events += 1
+        self._metrics["autoscale"].inc(
+            1.0, tags={"dataset": self.dataset_tag, "direction": direction})
+
+    def _publish_metrics(self, now: float, final: bool = False) -> None:
+        if not final and now - self._last_metrics < _METRICS_PERIOD_S:
+            return
+        self._last_metrics = now
+        m = self._metrics
+        for op in self.ops:
+            tags = {"dataset": self.dataset_tag, "op": op.name}
+            emitted = op.blocks_out - getattr(op, "_pub_blocks", 0)
+            if emitted:
+                m["blocks"].inc(emitted, tags=tags)
+                op._pub_blocks = op.blocks_out
+            rows = op.rows_out - getattr(op, "_pub_rows", 0)
+            if rows:
+                m["rows"].inc(rows, tags=tags)
+                op._pub_rows = op.rows_out
+            m["queued"].set(0 if final else len(op.inqueue), tags=tags)
+            m["inflight"].set(0 if final else op.num_inflight(), tags=tags)
+            if isinstance(op, ActorPoolMapOperator):
+                m["pool"].set(0 if final else op.pool_size(), tags=tags)
+        m["bytes"].set(0 if final else self._rm.held_bytes,
+                       tags={"dataset": self.dataset_tag})
+
+    # -- the scheduling tick --------------------------------------------
+    def _flow(self) -> bool:
+        moved = False
+        for up, down in zip(self.ops, self.ops[1:]):
+            while up.outqueue and down.can_accept_input():
+                down.add_input(up.outqueue.popleft())
+                moved = True
+            if up.exhausted() and not up.outqueue and not down.inputs_done:
+                down.mark_inputs_done()
+                moved = True
+        return moved
+
+    def _launchable(self, op: PhysicalOperator) -> bool:
+        if not op.can_launch():
+            return False
+        if len(op.outqueue) + op.pending_outputs() >= op.max_outqueue:
+            return False
+        if op.is_map:
+            from ray_tpu.data.planner import effective_window
+
+            if op.num_inflight() >= effective_window(op):
+                return False
+        headroom = self._rm.store_headroom()
+        if headroom is not None and headroom <= 0:
+            # Budget exhausted: drain toward the sink only. The deepest
+            # op with pending input nets bytes out of the execution
+            # fastest; producing new input is what got us here.
+            deepest = None
+            for candidate in self.ops:
+                if candidate.is_map and candidate.can_launch():
+                    deepest = candidate
+            if deepest is not None:
+                return op is deepest
+            # No map op can drain. Allow the input buffer only when the
+            # execution holds nothing at all — otherwise a budget
+            # smaller than one block would deadlock before the first
+            # block ever flows.
+            return isinstance(op, InputDataBuffer) and all(
+                not o.inqueue and not o.outqueue and o.num_inflight() == 0
+                and o.pending_outputs() == 0 for o in self.ops)
+        return True
+
+    def _tick(self) -> bool:
+        progressed = False
+        for op in self.ops:
+            if op.poll():
+                progressed = True
+        if self._flow():
+            progressed = True
+        now = time.monotonic()
+        for op in self.ops:
+            if isinstance(op, ActorPoolMapOperator):
+                op.maybe_autoscale(now)
+        # Launch loop: repeatedly pick the runnable op with the smallest
+        # output queue (bytes, then blocks owed) — the starved end of
+        # the pipeline — until nothing fits.
+        while True:
+            candidates = [op for op in self.ops if self._launchable(op)]
+            if not candidates:
+                break
+            op = min(candidates, key=lambda o: (
+                o.outqueue_bytes(),
+                len(o.outqueue) + o.pending_outputs()))
+            op.launch_one()
+            progressed = True
+        busy = sum(1 for op in self.ops if op.num_inflight() > 0)
+        self.max_concurrent_ops = max(self.max_concurrent_ops, busy)
+        self._publish_metrics(now)
+        if progressed:
+            self._last_progress = now
+        return progressed
+
+    def _wait_for_any(self) -> None:
+        """Block briefly for any in-flight task (metadata-only wait —
+        payloads are never pulled by the scheduler)."""
+        import ray_tpu
+
+        metas: List[Any] = []
+        for op in self.ops:
+            if op.is_map:
+                metas.extend(op.meta_refs())
+        if metas:
+            try:
+                ray_tpu.wait(metas, num_returns=1, timeout=0.05)
+                return
+            except Exception:  # noqa: BLE001
+                pass
+        time.sleep(0.002)
+
+    def _pump_until(self, cond) -> None:
+        # Always run at least one tick, even when output is already
+        # buffered: pulls are the executor's only clock (no background
+        # thread), so refill/autoscale/metrics must advance per pull or
+        # a pre-filled sink queue would freeze the rest of the pipeline
+        # until it drained.
+        first = True
+        while first or not cond():
+            first = False
+            progressed = self._tick()
+            if cond():
+                return
+            if self._finished():
+                return
+            if not progressed:
+                if (time.monotonic() - self._last_progress
+                        > _STALL_TIMEOUT_S):
+                    states = ", ".join(repr(op) for op in self.ops)
+                    raise RuntimeError(
+                        f"data execution stalled for "
+                        f">{_STALL_TIMEOUT_S:.0f}s "
+                        f"(held_bytes={self._rm.held_bytes}, "
+                        f"budget={self._rm.budget.store_bytes}): {states}")
+                self._wait_for_any()
+
+    def _finished(self) -> bool:
+        return all(op.exhausted() for op in self.ops)
+
+    # -- consumer API ---------------------------------------------------
+    def next_output(self) -> Any:
+        """Next sink block ref, in input order. Raises StopIteration
+        when the plan is exhausted."""
+        self._pump_until(lambda: bool(self.sink.outqueue))
+        if not self.sink.outqueue:
+            raise StopIteration
+        bundle = self.sink.outqueue.popleft()
+        # Handing the block to the consumer ends this execution's claim
+        # on its bytes.
+        self._rm.on_bytes_released(bundle.bytes_or(0))
+        return bundle.ref
+
+    def next_for_split(self, split_idx: int) -> Any:
+        """Next block ref for one streaming_split consumer. Raises
+        StopIteration when that split's stream is exhausted."""
+        assert self.splitter is not None, "executor not built with split_n"
+        q = self.splitter.split_queues[split_idx]
+        self._pump_until(lambda: bool(q))
+        if not q:
+            raise StopIteration
+        return q.pop(0).ref
+
+    def iter_outputs(self) -> Iterator[Any]:
+        try:
+            while True:
+                try:
+                    yield self.next_output()
+                except StopIteration:
+                    return
+        finally:
+            self.shutdown()
+
+    # -- lifecycle ------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "dataset": self.dataset_tag,
+            "duration_s": time.monotonic() - self._started_at,
+            "max_concurrent_ops": self.max_concurrent_ops,
+            "peak_held_bytes": self._rm.peak_held_bytes,
+            "store_bytes_budget": self._rm.budget.store_bytes,
+            "autoscale_events": self._autoscale_events,
+            "ops": [dict(op.stat_row(), name=op.name) for op in self.ops],
+        }
+
+    def shutdown(self) -> None:
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self._publish_metrics(time.monotonic(), final=True)
+        _RECENT.append(self.summary())
+        for op in self.ops:
+            try:
+                op.shutdown()
+            except Exception:  # noqa: BLE001
+                logger.exception("operator %s shutdown failed", op.name)
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def execute_plan(plan: List[Any], budget: Any = None) -> Iterator[Any]:
+    """Plan → iterator of sink block ObjectRefs on the streaming
+    executor (the non-split entry point dataset._exec_stream uses)."""
+    return StreamingExecutor(plan, budget=budget).iter_outputs()

@@ -115,6 +115,10 @@ class _MapBatchesActor:
     fn_constructor_kwargs: Optional[Dict[str, Any]] = None
     fn_kwargs: Optional[Dict[str, Any]] = None
     batch_format: Optional[str] = None
+    # Autoscaling ceiling: `concurrency` is the floor the pool starts
+    # at, `max_concurrency` what the executor's PoolAutoscalerPolicy may
+    # grow it to under sustained input-queue depth. None = fixed pool.
+    max_concurrency: Optional[int] = None
 
 
 def _apply_map_batches(op: _MapBatches, block: Block) -> Block:
@@ -147,6 +151,23 @@ def _fuse_plan(plan: List[Any]) -> List[Any]:
 # Streaming execution
 # ---------------------------------------------------------------------------
 def _exec_stream(plan: List[Any]) -> Iterator[Any]:
+    """Plan → iterator of Block ObjectRefs.
+
+    Default: the op-DAG streaming executor (data/_execution) — all
+    operators run concurrently under the ExecutionBudget with
+    output-queue-aware scheduling and actor-pool autoscaling. The
+    legacy per-stage generator chain survives for one PR behind
+    RAY_TPU_DATA_LEGACY_EXEC=1."""
+    import os
+
+    if os.environ.get("RAY_TPU_DATA_LEGACY_EXEC") == "1":
+        return _exec_stream_legacy(plan)
+    from ray_tpu.data._execution import execute_plan
+
+    return execute_plan(plan)
+
+
+def _exec_stream_legacy(plan: List[Any]) -> Iterator[Any]:
     """Plan → iterator of Block ObjectRefs (pull-based; bounded windows)."""
     plan = _fuse_plan(plan)
     src = plan[0]
@@ -271,23 +292,41 @@ class Dataset:
     # -- transforms (lazy) ------------------------------------------------
     def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
                     num_cpus: float = 1.0, num_tpus: float = 0.0,
-                    concurrency: int = DEFAULT_WINDOW,
+                    concurrency: Any = DEFAULT_WINDOW,
                     batch_format: Optional[str] = None,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[Dict[str, Any]] = None,
                     fn_kwargs: Optional[Dict[str, Any]] = None) -> "Dataset":
         """Function transforms run as tasks; a callable CLASS runs on a pool
         of `concurrency` stateful actors, constructed once each (reference:
-        TaskPoolMapOperator vs ActorPoolMapOperator). batch_format selects
-        what `fn` sees: "numpy" (default; zero-copy views for Arrow-backed
-        numeric columns), "pyarrow", or "pandas"."""
+        TaskPoolMapOperator vs ActorPoolMapOperator). For an actor class,
+        ``concurrency=(min, max)`` enables autoscaling: the pool starts at
+        `min` and the streaming executor grows it toward `max` on sustained
+        input-queue depth, draining back (idle-first) when the queue
+        empties. batch_format selects what `fn` sees: "numpy" (default;
+        zero-copy views for Arrow-backed numeric columns), "pyarrow", or
+        "pandas"."""
+        max_concurrency: Optional[int] = None
+        if isinstance(concurrency, (tuple, list)):
+            if not isinstance(fn, type):
+                raise ValueError(
+                    "concurrency=(min, max) autoscaling requires a callable "
+                    "class (actor pool); task-based map_batches takes an "
+                    "int concurrency")
+            lo, hi = concurrency
+            if int(lo) < 1 or int(hi) < int(lo):
+                raise ValueError(
+                    f"bad concurrency range {concurrency!r}: need "
+                    "1 <= min <= max")
+            concurrency, max_concurrency = int(lo), int(hi)
         if isinstance(fn, type):
             return Dataset(self._plan + [_MapBatchesActor(
                 fn, batch_size, concurrency=concurrency, num_cpus=num_cpus,
                 num_tpus=num_tpus, name=f"MapBatches({fn.__name__})",
                 fn_constructor_args=fn_constructor_args,
                 fn_constructor_kwargs=fn_constructor_kwargs,
-                fn_kwargs=fn_kwargs, batch_format=batch_format)])
+                fn_kwargs=fn_kwargs, batch_format=batch_format,
+                max_concurrency=max_concurrency)])
         return Dataset(self._plan + [_MapBatches(
             fn, batch_size, num_cpus, concurrency,
             name=getattr(fn, "__name__", "map_batches"),

@@ -158,7 +158,12 @@ def effective_window(op: Any) -> int:
 # ---------------------------------------------------------------------------
 
 class ExecutionBudget:
-    """Global budget one dataset execution may consume."""
+    """Global budget one dataset execution may consume.
+
+    ``store_bytes`` caps the bytes an execution keeps resident in the
+    object store at once (blocks held in operator queues and in flight);
+    the streaming executor gates launches on the remaining headroom.
+    None means unbounded."""
 
     def __init__(self, cpu_slots: Optional[float] = None,
                  store_bytes: Optional[int] = None):
@@ -168,6 +173,48 @@ class ExecutionBudget:
             cpu_slots = float(os.cpu_count() or 1)
         self.cpu_slots = cpu_slots
         self.store_bytes = store_bytes
+
+    @classmethod
+    def default(cls) -> "ExecutionBudget":
+        """Budget for executions that don't pass one: store cap from
+        RAY_TPU_DATA_STORE_BYTES, else 50% of the local arena capacity
+        (one execution should never monopolize the store), else
+        unbounded when no store is up."""
+        import os
+
+        env = os.environ.get("RAY_TPU_DATA_STORE_BYTES")
+        if env:
+            try:
+                return cls(store_bytes=int(env))
+            except ValueError:
+                logger.warning("ignoring bad RAY_TPU_DATA_STORE_BYTES=%r",
+                               env)
+        store_bytes = None
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker_or_none()
+            if w is not None:
+                store_bytes = int(w.shm.stats()["capacity"] * 0.5)
+        except Exception:  # noqa: BLE001
+            pass
+        return cls(store_bytes=store_bytes)
+
+
+# Process-wide override for the budget new executions default to
+# (tests and embedders; executions that pass an explicit budget are
+# unaffected).
+_default_budget: Optional[ExecutionBudget] = None
+
+
+def set_default_execution_budget(
+        budget: Optional[ExecutionBudget]) -> None:
+    global _default_budget
+    _default_budget = budget
+
+
+def default_execution_budget() -> ExecutionBudget:
+    return _default_budget or ExecutionBudget.default()
 
 
 class ResourceManager:
@@ -184,6 +231,11 @@ class ResourceManager:
         self.budget = budget or ExecutionBudget()
         self.reservation_frac = reservation_frac
         self._ops: Dict[int, Dict[str, Any]] = {}
+        # Bytes this execution currently keeps resident in the store
+        # (operator queues + in-flight inputs), counted against
+        # budget.store_bytes by the streaming executor.
+        self.held_bytes = 0
+        self.peak_held_bytes = 0
 
     # -- registration ---------------------------------------------------
     def register_ops(self, ops) -> None:
@@ -229,6 +281,21 @@ class ResourceManager:
         if st is not None and st["inflight"] > 0:
             st["inflight"] -= 1
 
+    def on_bytes_acquired(self, nbytes: int) -> None:
+        self.held_bytes += max(0, int(nbytes))
+        self.peak_held_bytes = max(self.peak_held_bytes, self.held_bytes)
+
+    def on_bytes_released(self, nbytes: int) -> None:
+        self.held_bytes = max(0, self.held_bytes - max(0, int(nbytes)))
+
+    def store_headroom(self) -> Optional[int]:
+        """Bytes the execution may still acquire (None = unbounded).
+        May go negative: block sizes are only known after they exist."""
+        cap = self.budget.store_bytes
+        if cap is None:
+            return None
+        return cap - self.held_bytes
+
     # -- the bound ------------------------------------------------------
     def max_inflight(self, op) -> int:
         st = self._ops.get(id(op))
@@ -237,7 +304,14 @@ class ResourceManager:
         per_task = st["cpu_per_task"]
         own = self._reserved_slots() / per_task
         shared = self._shared_pool_free() / per_task
-        return max(1, int(own + shared))
+        bound = max(1, int(own + shared))
+        headroom = self.store_headroom()
+        if headroom is not None and headroom <= 0:
+            # Over the store budget: drain mode. Shrink-only — never
+            # below 1, so forward progress (and thus release of held
+            # bytes) is always possible.
+            return 1
+        return bound
 
     def usage(self) -> Dict[str, Any]:
         return {
@@ -248,6 +322,9 @@ class ResourceManager:
             "cpu_slots": self.budget.cpu_slots,
             "reserved_per_op": self._reserved_slots(),
             "shared_free": self._shared_pool_free(),
+            "held_bytes": self.held_bytes,
+            "peak_held_bytes": self.peak_held_bytes,
+            "store_bytes": self.budget.store_bytes,
         }
 
 
